@@ -1,0 +1,43 @@
+"""kubeml-tpu: a TPU-native serverless-style distributed training framework.
+
+Re-designed from the ground up for JAX/XLA on TPU with the capabilities of the
+reference KubeML platform (spetrescu/kubeml): deploy plain Python model code with one
+command, and the platform shards data, runs elastic data-parallel K-step-averaging
+(local SGD) training over a TPU device mesh, validates, records metrics, and persists
+history. The Redis push/merge/pull weight exchange of the reference becomes a masked
+``pmean`` allreduce over ICI; serverless function pods become resident mesh workers.
+
+Public user API: :class:`kubeml_tpu.KubeModel`, :class:`kubeml_tpu.KubeDataset`.
+"""
+
+__version__ = "0.1.0"
+
+from .api import (  # noqa: F401
+    Config,
+    History,
+    TrainOptions,
+    TrainRequest,
+    get_config,
+    set_config,
+)
+
+# KubeModel / KubeDataset are imported lazily to keep `import kubeml_tpu` light for
+# control-plane-only processes (no jax import until a model is actually used).
+
+_LAZY = {
+    "KubeModel": ("kubeml_tpu.runtime.model", "KubeModel"),
+    "KubeDataset": ("kubeml_tpu.data.dataset", "KubeDataset"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod_name, attr = _LAZY[name]
+        try:
+            mod = importlib.import_module(mod_name)
+        except ImportError as e:
+            raise AttributeError(f"{name} unavailable: {e}") from e
+        return getattr(mod, attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

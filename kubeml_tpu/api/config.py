@@ -1,0 +1,115 @@
+"""One typed configuration layer for the whole framework.
+
+The reference scatters configuration across env vars (DEBUG_ENV, LIMIT_PARALLELISM,
+STANDALONE_JOBS, REDIS_URL, MONGO_IP, ...), hardcoded cluster DNS constants
+(reference: ml/pkg/api/const.go:4-30) and Helm values. Here a single ``Config``
+dataclass owns every knob, reads the environment once, and is passed (or defaulted)
+everywhere. Service addresses default to loopback so the full control plane runs
+in-process for tests — generalizing the reference's DEBUG_ENV/threaded-PS pattern
+(reference: ml/pkg/util/utils.go:26-37, ml/pkg/ps/api.go:211-217).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() not in ("0", "false", "no", "")
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+@dataclass
+class Config:
+    # --- data root: datasets, function registry, history, checkpoints ---
+    data_root: Path = field(
+        default_factory=lambda: Path(os.environ.get("KUBEML_DATA_ROOT", "~/.kubeml")).expanduser()
+    )
+
+    # --- service ports (reference cluster DNS const.go:4-14 -> local ports) ---
+    host: str = "127.0.0.1"
+    controller_port: int = field(default_factory=lambda: _env_int("KUBEML_CONTROLLER_PORT", 9090))
+    scheduler_port: int = field(default_factory=lambda: _env_int("KUBEML_SCHEDULER_PORT", 9091))
+    ps_port: int = field(default_factory=lambda: _env_int("KUBEML_PS_PORT", 9092))
+    storage_port: int = field(default_factory=lambda: _env_int("KUBEML_STORAGE_PORT", 9093))
+    metrics_port: int = field(default_factory=lambda: _env_int("KUBEML_METRICS_PORT", 8080))
+
+    # --- behavior flags (reference: util/utils.go:10-50, ps main.go:117-129) ---
+    debug: bool = field(default_factory=lambda: _env_bool("KUBEML_DEBUG"))
+    # limit_parallelism freezes scale-up like LIMIT_PARALLELISM (train/job.go:210-213)
+    limit_parallelism: bool = field(default_factory=lambda: _env_bool("LIMIT_PARALLELISM"))
+    # standalone_jobs: run each TrainJob in its own process (reference: dedicated pod,
+    # ps/job_pod.go) vs in-process thread (ps/api.go:211-217). Default threaded.
+    standalone_jobs: bool = field(default_factory=lambda: _env_bool("STANDALONE_JOBS"))
+
+    # --- TPU execution ---
+    platform: Optional[str] = field(default_factory=lambda: os.environ.get("KUBEML_PLATFORM"))
+    # max workers the scheduler may allocate; None -> len(jax.devices())
+    max_parallelism: Optional[int] = field(
+        default_factory=lambda: (
+            int(os.environ["KUBEML_MAX_PARALLELISM"]) if os.environ.get("KUBEML_MAX_PARALLELISM") else None
+        )
+    )
+    use_native_loader: bool = field(default_factory=lambda: _env_bool("KUBEML_NATIVE_LOADER", True))
+
+    @property
+    def datasets_dir(self) -> Path:
+        return self.data_root / "datasets"
+
+    @property
+    def functions_dir(self) -> Path:
+        return self.data_root / "functions"
+
+    @property
+    def history_path(self) -> Path:
+        return self.data_root / "history"
+
+    @property
+    def checkpoints_dir(self) -> Path:
+        return self.data_root / "checkpoints"
+
+    @property
+    def controller_url(self) -> str:
+        return f"http://{self.host}:{self.controller_port}"
+
+    @property
+    def scheduler_url(self) -> str:
+        return f"http://{self.host}:{self.scheduler_port}"
+
+    @property
+    def ps_url(self) -> str:
+        return f"http://{self.host}:{self.ps_port}"
+
+    @property
+    def storage_url(self) -> str:
+        return f"http://{self.host}:{self.storage_port}"
+
+    def ensure_dirs(self) -> None:
+        for d in (self.datasets_dir, self.functions_dir, self.history_path, self.checkpoints_dir):
+            d.mkdir(parents=True, exist_ok=True)
+
+
+_default_config: Optional[Config] = None
+
+
+def get_config() -> Config:
+    """Process-wide default config (lazily constructed from the environment)."""
+    global _default_config
+    if _default_config is None:
+        _default_config = Config()
+    return _default_config
+
+
+def set_config(cfg: Config) -> None:
+    global _default_config
+    _default_config = cfg

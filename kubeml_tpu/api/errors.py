@@ -1,0 +1,106 @@
+"""Error envelope shared across all services.
+
+Every kubeml-tpu service replies to failures with the JSON envelope
+``{"error": <message>, "code": <http status>}`` — the same contract the reference
+uses between its Go services and Python functions (reference: ml/pkg/error/error.go:14-34,
+python/kubeml/kubeml/exceptions.py). Exception classes carry the status code so the
+HTTP layer can serialize uniformly, and clients re-raise typed errors from envelopes.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+
+class KubeMLError(Exception):
+    """Base error with an HTTP status code and JSON envelope."""
+
+    status_code = 500
+
+    def __init__(self, message: str = "", status_code: Optional[int] = None):
+        super().__init__(message or self.__class__.__name__)
+        self.message = message or self.__class__.__name__
+        if status_code is not None:
+            self.status_code = status_code
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"error": self.message, "code": self.status_code}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+
+class MergeError(KubeMLError):
+    """Weight averaging / collective sync failed (reference: exceptions.py MergeError)."""
+
+    status_code = 500
+
+
+class DataError(KubeMLError):
+    """Dataset contents could not be loaded/decoded."""
+
+    status_code = 400
+
+
+class InvalidFormatError(KubeMLError):
+    """Uploaded dataset files are not .npy/.pkl or malformed."""
+
+    status_code = 400
+
+
+class StorageError(KubeMLError):
+    """Shard store / tensor store failure."""
+
+    status_code = 500
+
+
+class DatasetNotFoundError(KubeMLError):
+    status_code = 404
+
+    def __init__(self, name: str = ""):
+        super().__init__(f"dataset {name!r} not found" if name else "dataset not found")
+
+
+class DatasetExistsError(KubeMLError):
+    status_code = 400
+
+    def __init__(self, name: str = ""):
+        super().__init__(f"dataset {name!r} already exists" if name else "dataset exists")
+
+
+class InvalidArgsError(KubeMLError):
+    """Bad invocation arguments (reference: exceptions.py InvalidArgsError)."""
+
+    status_code = 500
+
+
+class FunctionNotFoundError(KubeMLError):
+    status_code = 404
+
+    def __init__(self, name: str = ""):
+        super().__init__(f"function {name!r} not found" if name else "function not found")
+
+
+class JobNotFoundError(KubeMLError):
+    status_code = 404
+
+    def __init__(self, job_id: str = ""):
+        super().__init__(f"job {job_id!r} not found" if job_id else "job not found")
+
+
+class NotReadyError(KubeMLError):
+    status_code = 503
+
+
+def error_from_envelope(body: bytes | str, default_code: int = 500) -> KubeMLError:
+    """Parse a ``{"error", "code"}`` envelope from a failed HTTP response into a
+    typed error (reference: ml/pkg/error/error.go:36-59 CheckFunctionError)."""
+    try:
+        d = json.loads(body)
+        msg = d.get("error", "unknown error")
+        code = int(d.get("code", default_code))
+    except (ValueError, TypeError, AttributeError):
+        msg = body.decode(errors="replace") if isinstance(body, bytes) else str(body)
+        code = default_code
+    return KubeMLError(msg, code)

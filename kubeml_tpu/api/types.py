@@ -1,0 +1,230 @@
+"""Core wire types for the kubeml-tpu control plane.
+
+These mirror the semantics of the reference's shared API types
+(reference: ml/pkg/api/types.go:13-112) — TrainRequest/TrainOptions drive a job,
+TrainTask carries it through the scheduler/PS, JobState feeds the elastic-parallelism
+policy, and History is the persisted per-job record — but are re-designed as typed
+Python dataclasses with JSON (de)serialization, replacing Go struct tags.
+
+TPU-specific additions over the reference:
+  * ``TrainOptions.mesh_shape`` / ``parallelism`` — parallelism here means the number
+    of data-parallel K-AVG workers, which on TPU map to mesh shards rather than
+    serverless function invocations.
+  * ``TrainOptions.precision`` — bf16/f32 compute policy (MXU-friendly default bf16).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# Defaults mirroring reference ml/pkg/api/const.go:16 (DefaultParallelism = 5) —
+# except on TPU parallelism moves in topology-legal steps, so the default is a
+# power of two that tiles a v5e-8 slice cleanly.
+DEFAULT_PARALLELISM = 4
+DEBUG_PARALLELISM = 2
+
+# Dataset shard granularity: the reference stores 64-sample MongoDB documents
+# (reference: python/storage/utils.py:6-25, controller/storageApi.go:20). We keep the
+# same subset size so K-interval math (util.py:59-81) carries over exactly.
+STORAGE_SUBSET_SIZE = 64
+
+
+class JobTaskType:
+    """Dispatch values for function invocations (reference: python/kubeml network.py:146-172)."""
+
+    INIT = "init"
+    TRAIN = "train"
+    VALIDATE = "val"
+    INFER = "infer"
+
+
+class JobStateEnum:
+    """Lifecycle states of a train task."""
+
+    QUEUED = "queued"
+    STARTING = "starting"
+    RUNNING = "running"
+    FINISHED = "finished"
+    FAILED = "failed"
+    STOPPED = "stopped"
+
+
+class _JsonMixin:
+    """JSON (de)serialization shared by all wire types."""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]):
+        names = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {}
+        for k, v in d.items():
+            if k not in names:
+                continue
+            kwargs[k] = v
+        obj = cls(**kwargs)  # type: ignore[call-arg]
+        return obj
+
+    @classmethod
+    def from_json(cls, s: str):
+        return cls.from_dict(json.loads(s))
+
+
+@dataclass
+class TrainOptions(_JsonMixin):
+    """Tunables of a training job (reference: ml/pkg/api/types.go:13-24).
+
+    ``k`` is the K-AVG sync period in *local steps*: workers run K optimizer steps on
+    their shard and then average weights. ``k == -1`` means "sparse averaging" — one
+    sync per epoch (reference: python/kubeml/kubeml/util.py:59-81).
+    """
+
+    default_parallelism: int = DEFAULT_PARALLELISM
+    static_parallelism: bool = False
+    validate_every: int = 1
+    k: int = 16
+    goal_accuracy: float = 100.0
+    # --- TPU-native extensions ---
+    precision: str = "bf16"  # compute dtype for matmul/conv (MXU native)
+    mesh_shape: Optional[Dict[str, int]] = None  # explicit mesh override {axis: size}
+    donate: bool = True  # donate params buffers into the jitted step
+
+    def __post_init__(self):
+        if self.validate_every < 0:
+            raise ValueError("validate_every must be >= 0")
+        if self.k == 0 or self.k < -1:
+            raise ValueError("k must be -1 (sparse) or a positive step count")
+
+
+@dataclass
+class TrainRequest(_JsonMixin):
+    """A user request to train a model (reference: ml/pkg/api/types.go:26-37)."""
+
+    model_type: str = ""
+    batch_size: int = 64
+    epochs: int = 1
+    dataset: str = ""
+    lr: float = 0.01
+    function_name: str = ""
+    options: TrainOptions = field(default_factory=TrainOptions)
+
+    def __post_init__(self):
+        if isinstance(self.options, dict):
+            self.options = TrainOptions.from_dict(self.options)
+
+    def validate(self) -> None:
+        if not self.function_name:
+            raise ValueError("function_name is required")
+        if not self.dataset:
+            raise ValueError("dataset is required")
+        if self.epochs < 1:
+            raise ValueError("epochs must be >= 1")
+        if not (0 < self.batch_size <= 1024):
+            # reference CLI enforces batch <= 1024 (cmd/train.go:120-133)
+            raise ValueError("batch_size must be in (0, 1024]")
+
+
+@dataclass
+class InferRequest(_JsonMixin):
+    """Inference against a trained job's model (reference: ml/pkg/api/types.go:96-100)."""
+
+    model_id: str = ""
+    data: Any = None
+
+
+@dataclass
+class JobState(_JsonMixin):
+    """Per-epoch state the job reports to the scheduler for re-evaluation of
+    parallelism (reference: ml/pkg/api/types.go:68-71)."""
+
+    parallelism: int = 0
+    elapsed_time: float = -1.0  # seconds of the last epoch; -1 on first call
+
+
+@dataclass
+class TrainTask(_JsonMixin):
+    """A scheduled training task flowing controller -> scheduler -> PS -> job
+    (reference: ml/pkg/api/types.go:41-65)."""
+
+    job_id: str = ""
+    parameters: TrainRequest = field(default_factory=TrainRequest)
+    state: JobState = field(default_factory=JobState)
+    status: str = JobStateEnum.QUEUED
+    started_at: float = field(default_factory=time.time)
+
+    def __post_init__(self):
+        if isinstance(self.parameters, dict):
+            self.parameters = TrainRequest.from_dict(self.parameters)
+        if isinstance(self.state, dict):
+            self.state = JobState.from_dict(self.state)
+
+
+@dataclass
+class MetricUpdate(_JsonMixin):
+    """Metrics pushed job -> PS each epoch/validation (reference: ml/pkg/api/types.go:74-81)."""
+
+    job_id: str = ""
+    validation_loss: float = 0.0
+    accuracy: float = 0.0
+    train_loss: float = 0.0
+    parallelism: int = 0
+    epoch_duration: float = 0.0
+
+
+@dataclass
+class History(_JsonMixin):
+    """Full training history persisted at job end (reference: ml/pkg/api/types.go:84-93,
+    written by ml/pkg/train/util.go:247-280)."""
+
+    id: str = ""
+    task: Optional[Dict[str, Any]] = None
+    validation_loss: List[float] = field(default_factory=list)
+    accuracy: List[float] = field(default_factory=list)
+    train_loss: List[float] = field(default_factory=list)
+    parallelism: List[int] = field(default_factory=list)
+    epoch_duration: List[float] = field(default_factory=list)
+
+    def append_epoch(
+        self,
+        train_loss: float,
+        parallelism: int,
+        duration: float,
+        validation_loss: Optional[float] = None,
+        accuracy: Optional[float] = None,
+    ) -> None:
+        self.train_loss.append(float(train_loss))
+        self.parallelism.append(int(parallelism))
+        self.epoch_duration.append(float(duration))
+        if validation_loss is not None:
+            self.validation_loss.append(float(validation_loss))
+        if accuracy is not None:
+            self.accuracy.append(float(accuracy))
+
+
+@dataclass
+class DatasetSummary(_JsonMixin):
+    """Dataset listing entry (reference: ml/pkg/api/types.go:103-108, computed at
+    controller/storageApi.go:70-189 as doc count x 64)."""
+
+    name: str = ""
+    train_set_size: int = 0
+    test_set_size: int = 0
+
+
+@dataclass
+class JobInfo(_JsonMixin):
+    """PS-side record of a live job (reference: ml/pkg/api/types.go:59-65)."""
+
+    job_id: str = ""
+    status: str = JobStateEnum.STARTING
+    parallelism: int = 0
+    function_name: str = ""
+    dataset: str = ""

@@ -1,0 +1,55 @@
+"""Test fixtures.
+
+Multi-chip tests run on a virtual 8-device CPU mesh via
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` — the environment must be set
+before jax initializes its backends, so it happens at conftest import time (this is
+the generalization of the reference's DEBUG_ENV/threaded in-proc test pattern,
+reference: ml/tests/integration.go:14-36).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def tmp_config(tmp_path):
+    """A Config rooted in a temp dir with free ports, installed as process default."""
+    from kubeml_tpu.api.config import Config, set_config
+    import socket
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    cfg = Config(
+        data_root=tmp_path / "kubeml",
+        controller_port=free_port(),
+        scheduler_port=free_port(),
+        ps_port=free_port(),
+        storage_port=free_port(),
+    )
+    cfg.ensure_dirs()
+    set_config(cfg)
+    yield cfg
+    set_config(Config())
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def make_blobs(n, shape=(8, 8, 1), classes=10, seed=0):
+    """Tiny synthetic labeled dataset (images, int labels)."""
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, *shape)).astype(np.float32)
+    y = r.integers(0, classes, size=(n,)).astype(np.int64)
+    return x, y

@@ -1,0 +1,98 @@
+"""Unit tests for the core wire types and error envelope."""
+
+import json
+
+import pytest
+
+from kubeml_tpu.api import (
+    History,
+    JobState,
+    KubeMLError,
+    TrainOptions,
+    TrainRequest,
+    TrainTask,
+    error_from_envelope,
+)
+from kubeml_tpu.api.errors import DatasetNotFoundError
+
+
+def test_train_request_roundtrip():
+    req = TrainRequest(
+        model_type="resnet34",
+        batch_size=128,
+        epochs=5,
+        dataset="cifar10",
+        lr=0.1,
+        function_name="resnet",
+        options=TrainOptions(default_parallelism=8, k=16, goal_accuracy=90.0),
+    )
+    back = TrainRequest.from_json(req.to_json())
+    assert back == req
+    assert back.options.k == 16
+
+
+def test_train_request_options_from_dict():
+    req = TrainRequest.from_dict(
+        {
+            "function_name": "lenet",
+            "dataset": "mnist",
+            "batch_size": 64,
+            "epochs": 2,
+            "options": {"k": -1, "static_parallelism": True},
+        }
+    )
+    assert req.options.k == -1
+    assert req.options.static_parallelism is True
+
+
+def test_train_request_validation():
+    req = TrainRequest(function_name="f", dataset="d", batch_size=2048)
+    with pytest.raises(ValueError):
+        req.validate()
+    req = TrainRequest(function_name="", dataset="d")
+    with pytest.raises(ValueError):
+        req.validate()
+    TrainRequest(function_name="f", dataset="d").validate()
+
+
+def test_train_options_k_zero_rejected():
+    with pytest.raises(ValueError):
+        TrainOptions(k=0)
+
+
+def test_train_task_nested_roundtrip():
+    task = TrainTask(job_id="abc12345", parameters=TrainRequest(function_name="f", dataset="d"))
+    back = TrainTask.from_json(task.to_json())
+    assert back.job_id == "abc12345"
+    assert isinstance(back.parameters, TrainRequest)
+    assert isinstance(back.state, JobState)
+
+
+def test_history_append():
+    h = History(id="job1")
+    h.append_epoch(train_loss=1.5, parallelism=4, duration=2.0, validation_loss=1.2, accuracy=55.0)
+    h.append_epoch(train_loss=1.1, parallelism=5, duration=1.8)
+    assert h.train_loss == [1.5, 1.1]
+    assert h.parallelism == [4, 5]
+    assert h.validation_loss == [1.2]
+    assert h.accuracy == [55.0]
+
+
+def test_error_envelope_shape():
+    err = DatasetNotFoundError("mnist")
+    d = err.to_dict()
+    assert set(d) == {"error", "code"}
+    assert d["code"] == 404
+    assert "mnist" in d["error"]
+
+
+def test_error_from_envelope_parses_json():
+    err = error_from_envelope(json.dumps({"error": "boom", "code": 503}))
+    assert isinstance(err, KubeMLError)
+    assert err.status_code == 503
+    assert err.message == "boom"
+
+
+def test_error_from_envelope_garbage():
+    err = error_from_envelope(b"<html>panic</html>", default_code=500)
+    assert err.status_code == 500
