@@ -1,0 +1,98 @@
+"""KubeDataset — the user-facing dataset handle.
+
+Mirrors the reference's ``KubeDataset`` contract (reference:
+python/kubeml/kubeml/dataset.py:91-148): the user names a stored dataset; the
+platform validates it exists, exposes train/test sizes, and flips a train/val mode
+flag the user can branch on inside their ``transform`` override (the reference's
+pattern of switching torchvision transforms on ``is_training()``, e.g.
+ml/experiments/kubeml/function_resnet34.py:13-44).
+
+Unlike the reference there is no per-item ``__getitem__`` — data flows in whole
+sync-round slabs (see ``kubeml_tpu.data.loader``) and ``transform`` operates on
+full numpy arrays at once, which is both faster on the host and what a TPU input
+pipeline wants."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..api.errors import DatasetNotFoundError
+from ..storage.store import DatasetHandle, ShardStore
+
+
+@dataclass
+class TrainParams:
+    """Per-invocation parameters — the equivalent of the reference's ``_KubeArgs``
+    parsed from function query args (reference: dataset.py:57-78; built at
+    ml/pkg/train/function.go:44-68)."""
+
+    job_id: str
+    n_workers: int
+    k: int
+    task: str
+    func_id: int = 0
+    lr: float = 0.01
+    batch_size: int = 64
+    epoch: int = 0
+
+
+class KubeDataset:
+    """User-facing dataset: subclass and override :meth:`transform` if needed.
+
+    The runtime attaches the storage handle before any task runs; user code only
+    names the dataset::
+
+        class Cifar(KubeDataset):
+            def __init__(self):
+                super().__init__("cifar10")
+
+            def transform(self, x, y):
+                if self.is_training():
+                    x = random_crop_flip(x)
+                return normalize(x), y
+    """
+
+    def __init__(self, dataset_name: str):
+        self.dataset = dataset_name
+        self._handle: Optional[DatasetHandle] = None
+        self._training = True
+
+    # --- runtime wiring ---
+
+    def _attach(self, store: ShardStore) -> None:
+        if not store.exists(self.dataset):
+            raise DatasetNotFoundError(self.dataset)
+        self._handle = store.get(self.dataset)
+
+    @property
+    def handle(self) -> DatasetHandle:
+        if self._handle is None:
+            raise RuntimeError(
+                "KubeDataset is not attached to a store; it must be run by the "
+                "kubeml-tpu runtime (or call _attach() in tests)"
+            )
+        return self._handle
+
+    def set_mode(self, training: bool) -> None:
+        self._training = training
+
+    # --- user surface (reference: dataset.py:128-148) ---
+
+    def is_training(self) -> bool:
+        return self._training
+
+    @property
+    def num_train(self) -> int:
+        return self.handle.num_samples("train")
+
+    @property
+    def num_test(self) -> int:
+        return self.handle.num_samples("test")
+
+    def transform(self, x: np.ndarray, y: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Whole-array preprocessing hook; default identity. Called on host numpy
+        arrays for each sync round's slab (train) or the validation set (val)."""
+        return x, y

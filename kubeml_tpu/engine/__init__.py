@@ -1,0 +1,2 @@
+from .kavg import KAvgTrainer, worker_mesh  # noqa: F401
+from .job import TrainJob  # noqa: F401

@@ -1,0 +1,255 @@
+"""TrainJob — the per-job training engine.
+
+The TPU-native counterpart of the reference's core runtime
+(reference: ml/pkg/train/job.go:156-265): drives the epoch loop — init, per-epoch
+train rounds, elastic parallelism re-evaluation, periodic validation, goal-accuracy
+early stop, metrics push, history persistence — but where the reference fans out N
+HTTP function invocations and merges weights through Redis, this job feeds sync
+rounds to the in-process :class:`KAvgTrainer` whose averaging is an on-chip
+collective.
+
+Decoupled from the control plane via two callbacks so it runs identically
+in-process (tests), threaded under the PS, or standalone:
+
+* ``on_epoch_end(JobState) -> new_parallelism`` — the scheduler hook
+  (reference: job.go:196-215 asking the scheduler for next-epoch parallelism);
+* ``on_metrics(MetricUpdate)`` — the PS metrics push (train/util.go:20-50).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from ..api.errors import KubeMLError
+from ..api.types import History, JobState, MetricUpdate, TrainRequest
+from ..data.dataset import KubeDataset
+from ..data.loader import RoundLoader, validation_loader
+from ..data.sharding import plan_epoch
+from ..runtime.model import KubeModel
+from ..storage.history import HistoryStore
+from ..storage.store import ShardStore
+from .kavg import KAvgTrainer
+
+log = logging.getLogger("kubeml.job")
+
+
+class TrainJob:
+    def __init__(
+        self,
+        job_id: str,
+        request: TrainRequest,
+        model: KubeModel,
+        store: Optional[ShardStore] = None,
+        history_store: Optional[HistoryStore] = None,
+        on_epoch_end: Optional[Callable[[JobState], int]] = None,
+        on_metrics: Optional[Callable[[MetricUpdate], None]] = None,
+        devices=None,
+        seed: int = 0,
+    ):
+        self.job_id = job_id
+        self.request = request
+        self.model = model
+        self.store = store or ShardStore()
+        self.history_store = history_store or HistoryStore()
+        self.on_epoch_end = on_epoch_end
+        self.on_metrics = on_metrics
+        self.seed = seed
+
+        self.parallelism = request.options.default_parallelism
+        self.trainer = KAvgTrainer(
+            model, precision=request.options.precision, devices=devices,
+            donate=request.options.donate,
+        )
+        self.history = History(id=job_id, task={"request": request.to_dict()})
+        self.stop_event = threading.Event()
+        self.exit_error: Optional[str] = None
+        self._stacked_vars = None
+        self._final_variables = None
+
+    # --- public control (reference: train/api.go /stop) ---
+
+    def stop(self) -> None:
+        self.stop_event.set()
+
+    @property
+    def state(self) -> JobState:
+        return JobState(parallelism=self.parallelism)
+
+    # --- main loop (reference: job.go:156-265) ---
+
+    def train(self) -> History:
+        req = self.request
+        opts = req.options
+        try:
+            dataset: KubeDataset = self.model.dataset
+            dataset._attach(self.store)
+            handle = dataset.handle
+
+            # init: build + broadcast initial variables (job.go:268-291 init fn)
+            rng = jax.random.PRNGKey(self.seed)
+            dataset.set_mode(True)
+            sample_x, _ = handle.load_subset_range("train", 0, 1)
+            sample_x, _ = dataset.transform(np.asarray(sample_x), None)
+            sample_x = sample_x[: req.batch_size]
+            self._stacked_vars = self.trainer.init_variables(
+                rng, sample_x, self.parallelism
+            )
+
+            val_acc = 0.0
+            for epoch in range(req.epochs):
+                if self.stop_event.is_set():
+                    log.info("%s: stop requested, exiting at epoch %d", self.job_id, epoch)
+                    break
+                t0 = time.time()
+                used_parallelism = self.parallelism
+                train_loss = self._train_epoch(epoch, handle, dataset)
+                elapsed = time.time() - t0
+
+                # elastic re-evaluation (job.go:196-215): ask the scheduler with
+                # this epoch's elapsed time unless parallelism is static
+                if not opts.static_parallelism and self.on_epoch_end is not None:
+                    new_p = self.on_epoch_end(
+                        JobState(parallelism=self.parallelism, elapsed_time=elapsed)
+                    )
+                    if new_p and new_p != self.parallelism:
+                        log.info(
+                            "%s: parallelism %d -> %d", self.job_id, self.parallelism, new_p
+                        )
+                        self._stacked_vars = self.trainer.resize(
+                            self._stacked_vars, self.parallelism, new_p
+                        )
+                        self.parallelism = new_p
+
+                # periodic validation (job.go:223-243)
+                val_loss = None
+                acc_pct = None
+                if opts.validate_every > 0 and (epoch + 1) % opts.validate_every == 0:
+                    val_acc, val_loss = self._validate(dataset, handle)
+                    acc_pct = val_acc * 100.0
+
+                self.history.append_epoch(
+                    train_loss=train_loss,
+                    parallelism=used_parallelism,
+                    duration=elapsed,
+                    validation_loss=val_loss,
+                    accuracy=acc_pct,
+                )
+                self._push_metrics(train_loss, val_loss, acc_pct, elapsed, used_parallelism)
+                log.info(
+                    "%s: epoch %d/%d loss=%.4f acc=%s parallelism=%d %.2fs",
+                    self.job_id, epoch + 1, req.epochs, train_loss,
+                    f"{acc_pct:.2f}%" if acc_pct is not None else "-",
+                    used_parallelism, elapsed,
+                )
+
+                # goal-accuracy early stop (job.go:49-54, 233-243)
+                if acc_pct is not None and acc_pct >= opts.goal_accuracy:
+                    log.info(
+                        "%s: goal accuracy %.2f%% reached (%.2f%%)",
+                        self.job_id, opts.goal_accuracy, acc_pct,
+                    )
+                    break
+
+            # final validation if the last epoch didn't run one (job.go:247-255);
+            # validate_every == 0 means the user opted out of validation entirely
+            if (
+                opts.validate_every > 0
+                and self.history.accuracy == []
+                and not self.stop_event.is_set()
+            ):
+                val_acc, val_loss = self._validate(dataset, handle)
+                self.history.validation_loss.append(float(val_loss))
+                self.history.accuracy.append(float(val_acc * 100.0))
+
+            self._final_variables = self.trainer.reference_variables(self._stacked_vars)
+        except KubeMLError as e:
+            self.exit_error = e.message
+            raise
+        except Exception as e:
+            self.exit_error = str(e)
+            raise KubeMLError(f"job {self.job_id} failed: {e}") from e
+        finally:
+            # persist whatever history exists, like the deferred save+finish
+            # (job.go:161-170); tensor GC is implicit — device buffers die with us
+            if self.history.train_loss or self.history.accuracy:
+                self.history_store.save(self.history)
+        return self.history
+
+    # --- internals ---
+
+    def _train_epoch(self, epoch: int, handle, dataset: KubeDataset) -> float:
+        req = self.request
+        dataset.set_mode(True)
+        plan = plan_epoch(
+            num_docs=handle.num_subsets("train"),
+            n_workers=self.parallelism,
+            batch_size=req.batch_size,
+            k=req.options.k,
+            subset_size=handle.subset_size,
+            num_samples=handle.num_samples("train"),
+        )
+        loader = RoundLoader(handle, "train", plan, transform=dataset.transform)
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch + 1)
+        losses = []
+        for rb in loader:
+            if self.stop_event.is_set():
+                break
+            self._stacked_vars, loss = self.trainer.sync_round(
+                self._stacked_vars,
+                rb.x,
+                rb.y,
+                rb.mask,
+                jax.random.fold_in(rng, rb.round_index),
+                lr=req.lr,
+                epoch=epoch,
+            )
+            losses.append(loss)
+        if not losses:
+            raise KubeMLError(f"job {self.job_id}: epoch produced no rounds")
+        # one blocking host read per epoch, not per round (keeps rounds async)
+        return float(np.mean([float(l) for l in losses]))
+
+    def _validate(self, dataset: KubeDataset, handle):
+        dataset.set_mode(False)
+        loader = validation_loader(
+            handle, self.parallelism, self.request.batch_size, transform=dataset.transform
+        )
+        acc, loss = self.trainer.evaluate_rounds(self._stacked_vars, loader)
+        dataset.set_mode(True)
+        return acc, loss
+
+    def _push_metrics(self, train_loss, val_loss, acc_pct, elapsed, parallelism) -> None:
+        if self.on_metrics is None:
+            return
+        try:
+            self.on_metrics(
+                MetricUpdate(
+                    job_id=self.job_id,
+                    train_loss=float(train_loss),
+                    validation_loss=float(val_loss) if val_loss is not None else 0.0,
+                    accuracy=float(acc_pct) if acc_pct is not None else 0.0,
+                    parallelism=parallelism,
+                    epoch_duration=float(elapsed),
+                )
+            )
+        except Exception:
+            log.exception("%s: metrics push failed (non-fatal)", self.job_id)
+
+    # --- results ---
+
+    @property
+    def final_variables(self):
+        """The trained reference model (fixes the reference's 'weights die with
+        the job' gap — SURVEY §5 checkpoint/resume)."""
+        return self._final_variables
+
+    def infer(self, x: np.ndarray):
+        if self._stacked_vars is None:
+            raise KubeMLError(f"job {self.job_id} has no model yet", 400)
+        return self.trainer.infer(self._stacked_vars, x)

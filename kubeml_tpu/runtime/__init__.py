@@ -1,0 +1,1 @@
+from .model import KubeModel  # noqa: F401

@@ -1,0 +1,134 @@
+"""KubeModel — the user-facing model API.
+
+The reference's ``KubeModel`` is an imperative torch ABC: users override
+``init/train/validate/infer`` and the platform drives them per task
+(reference: python/kubeml/kubeml/network.py:29-52, 463-476). The JAX re-design
+keeps the same "write your model, never touch devices or distribution" promise but
+with a *functional* contract the engine can ``jit``/``shard_map``:
+
+* ``build()`` returns a Flax module (required);
+* ``per_sample_loss``/``per_sample_correct`` act on logits and return per-sample
+  vectors — the engine applies validity masks and reductions, which is how padded
+  lockstep batches and partial-worker failures stay out of user code;
+* ``configure_optimizers()`` returns an optax transformation (reference
+  network.py:463-467), re-initialized at every sync round exactly like the
+  reference resets optimizer state each iteration (network.py:121-128);
+* mutable collections (e.g. BatchNorm ``batch_stats``) live alongside params in
+  one ``variables`` pytree and are averaged at sync like the reference averages
+  the full state_dict including BN counters (ml/pkg/model/parallelSGD.go:26-54).
+
+User code never imports jax.sharding, never sees the mesh, and never calls a
+collective — distribution is entirely the platform's job.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..data.dataset import KubeDataset
+
+
+class KubeModel(ABC):
+    """Subclass, implement :meth:`build`, optionally override the hooks::
+
+        class KubeLeNet(KubeModel):
+            def __init__(self):
+                super().__init__(MnistDataset())
+
+            def build(self):
+                return LeNet(num_classes=10)
+
+            def configure_optimizers(self):
+                return optax.sgd(self.lr, momentum=0.9)
+    """
+
+    # Set True in a subclass whose configure_optimizers reads self.epoch (e.g.
+    # epoch-based lr decay, reference function_resnet34.py:52-63): the engine then
+    # re-traces the sync round when the epoch changes. Left False (default), one
+    # compiled program serves every epoch.
+    epoch_in_schedule: bool = False
+
+    def __init__(self, dataset: KubeDataset):
+        self._dataset = dataset
+        self._module = None
+        # per-invocation parameters, set by the runtime before any task runs
+        # (the reference reads them from request args each call, network.py:91-97)
+        self.lr: float = 0.01
+        self.batch_size: int = 64
+        self.epoch: int = 0
+        self.k: int = -1
+        self.task: str = ""
+
+    # --- wiring ---
+
+    @property
+    def dataset(self) -> KubeDataset:
+        return self._dataset
+
+    @property
+    def module(self):
+        if self._module is None:
+            self._module = self.build()
+        return self._module
+
+    def _set_params(self, *, lr: float, batch_size: int, epoch: int, k: int, task: str) -> None:
+        self.lr = lr
+        self.batch_size = batch_size
+        self.epoch = epoch
+        self.k = k
+        self.task = task
+
+    # --- required user surface ---
+
+    @abstractmethod
+    def build(self):
+        """Return the Flax module for this model."""
+
+    # --- overridable hooks (all jax-pure: traced under jit) ---
+
+    def init(self, rng: jax.Array, sample_x: jnp.ndarray) -> Dict[str, Any]:
+        """Initialize the full variables pytree ({'params': ..., maybe
+        'batch_stats': ...}) from one sample batch."""
+        return self.module.init(rng, sample_x, train=False)
+
+    def forward(
+        self,
+        variables: Dict[str, Any],
+        x: jnp.ndarray,
+        train: bool,
+        rng: Optional[jax.Array] = None,
+    ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+        """Run the module; returns (logits, updated mutable state). Mutable
+        collections (everything except 'params') are updated only when training."""
+        mutable = [k for k in variables if k != "params"]
+        rngs = {"dropout": rng} if (train and rng is not None) else None
+        if train and mutable:
+            logits, new_state = self.module.apply(
+                variables, x, train=True, mutable=mutable, rngs=rngs
+            )
+            return logits, dict(new_state)
+        logits = self.module.apply(variables, x, train=train, rngs=rngs)
+        return logits, {}
+
+    def per_sample_loss(self, logits: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+        """Per-sample losses [B]; default integer-label softmax cross-entropy."""
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y)
+
+    def per_sample_correct(self, logits: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+        """Per-sample 0/1 correctness [B] for accuracy; default argmax match."""
+        return (jnp.argmax(logits, axis=-1) == y).astype(jnp.float32)
+
+    def configure_optimizers(self) -> optax.GradientTransformation:
+        """Optimizer; default plain SGD at the job's lr (reference default is the
+        user's choice; examples use SGD with momentum)."""
+        return optax.sgd(self.lr)
+
+    def infer(self, variables: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+        """Prediction for raw inference payloads; default class ids."""
+        logits, _ = self.forward(variables, x, train=False)
+        return jnp.argmax(logits, axis=-1)

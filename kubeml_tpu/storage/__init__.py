@@ -1,0 +1,3 @@
+from .store import DatasetHandle, ShardStore  # noqa: F401
+from .history import HistoryStore  # noqa: F401
+from .service import StorageService  # noqa: F401

@@ -1,0 +1,116 @@
+"""Storage service — HTTP front door for dataset upload/delete.
+
+Keeps the reference's route contract (reference: python/storage/api.py:37-51):
+``POST /dataset/<name>`` with four multipart files named ``x-train``, ``y-train``,
+``x-test``, ``y-test`` (``.npy`` or ``.pkl``), ``DELETE /dataset/<name>``, plus
+``GET /dataset/<name>`` (summary) and ``GET /dataset`` (list) which the reference
+serves from the controller by counting Mongo docs (controller/storageApi.go:70-189)
+— here the store answers directly from manifests.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from email.message import Message
+from email.parser import BytesParser
+from email.policy import HTTP
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..api.config import Config, get_config
+from ..api.errors import InvalidFormatError, KubeMLError
+from ..utils.httpd import Request, Router, Service
+from .store import ShardStore
+
+REQUIRED_FILES = ("x-train", "y-train", "x-test", "y-test")
+
+
+def parse_multipart(body: bytes, content_type: str) -> Dict[str, bytes]:
+    """Parse a multipart/form-data body into {field name: payload bytes}."""
+    if "multipart/form-data" not in (content_type or ""):
+        raise InvalidFormatError("expected multipart/form-data upload")
+    parser = BytesParser(policy=HTTP)
+    msg: Message = parser.parsebytes(
+        b"Content-Type: " + content_type.encode() + b"\r\n\r\n" + body
+    )
+    if not msg.is_multipart():
+        raise InvalidFormatError("malformed multipart body")
+    out: Dict[str, bytes] = {}
+    for part in msg.iter_parts():
+        name = part.get_param("name", header="content-disposition")
+        if name:
+            out[name] = part.get_payload(decode=True) or b""
+    return out
+
+
+def decode_array(payload: bytes, field: str) -> np.ndarray:
+    """Decode one uploaded file: .npy bytes or a pickled array/list
+    (reference storage accepts both, api.py:30-44 _load_dataset)."""
+    if payload[:6] == b"\x93NUMPY":
+        try:
+            return np.load(io.BytesIO(payload), allow_pickle=False)
+        except ValueError as e:
+            raise InvalidFormatError(f"{field}: bad .npy file: {e}")
+    try:
+        obj = pickle.loads(payload)
+    except Exception as e:
+        raise InvalidFormatError(f"{field}: not a .npy or pickle file: {e}")
+    try:
+        return np.asarray(obj)
+    except Exception as e:
+        raise InvalidFormatError(f"{field}: pickled object is not array-like: {e}")
+
+
+class StorageService:
+    def __init__(self, store: Optional[ShardStore] = None, config: Optional[Config] = None):
+        self.cfg = config or get_config()
+        self.store = store or ShardStore(config=self.cfg)
+        router = Router("storage")
+        router.route("GET", "/dataset", self._list)
+        router.route("GET", "/dataset/{name}", self._get)
+        router.route("POST", "/dataset/{name}", self._create)
+        router.route("DELETE", "/dataset/{name}", self._delete)
+        self.service = Service(router, self.cfg.host, self.cfg.storage_port)
+
+    # --- handlers ---
+
+    def _list(self, req: Request):
+        return [s.to_dict() for s in self.store.list()]
+
+    def _get(self, req: Request):
+        return self.store.get(req.params["name"]).summary().to_dict()
+
+    def _create(self, req: Request):
+        name = req.params["name"]
+        files = parse_multipart(req.body, req.headers.get("Content-Type", ""))
+        missing = [f for f in REQUIRED_FILES if f not in files]
+        if missing:
+            raise KubeMLError(f"missing upload files: {missing}", 400)
+        arrays = {f: decode_array(files[f], f) for f in REQUIRED_FILES}
+        summary = self.store.create(
+            name,
+            x_train=arrays["x-train"],
+            y_train=arrays["y-train"],
+            x_test=arrays["x-test"],
+            y_test=arrays["y-test"],
+        )
+        return summary.to_dict()
+
+    def _delete(self, req: Request):
+        self.store.delete(req.params["name"])
+        return {"deleted": req.params["name"]}
+
+    # --- lifecycle ---
+
+    def start(self) -> "StorageService":
+        self.service.start()
+        return self
+
+    def stop(self) -> None:
+        self.service.stop()
+
+    @property
+    def url(self) -> str:
+        return self.service.url

@@ -1,0 +1,1 @@
+from .httpd import Request, Response, Router, Service  # noqa: F401

@@ -1,0 +1,172 @@
+"""Minimal routed HTTP server shared by every kubeml-tpu service.
+
+The reference's services are Go mux routers (gorilla/mux) and Flask apps speaking
+JSON with the ``{error, code}`` envelope on failure (reference:
+ml/pkg/controller/api.go:16-42, ml/environment/server.py:133-151). Flask is not a
+dependency here; this is a small stdlib ``ThreadingHTTPServer`` with:
+
+* pattern routes with ``{param}`` captures, per-method handlers
+* automatic JSON body/response handling
+* ``KubeMLError`` -> envelope serialization, generic exceptions -> 500 envelope
+* a ``/health`` route on every service by default
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..api.errors import KubeMLError
+
+log = logging.getLogger("kubeml.httpd")
+
+Handler = Callable[["Request"], Any]
+
+
+class Request:
+    """Parsed incoming request handed to route handlers."""
+
+    def __init__(self, method: str, path: str, params: Dict[str, str], query: Dict[str, List[str]], body: bytes, headers):
+        self.method = method
+        self.path = path
+        self.params = params  # {param} captures from the route pattern
+        self.query = query
+        self.body = body
+        self.headers = headers
+
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        try:
+            return json.loads(self.body)
+        except ValueError as e:
+            raise KubeMLError(f"invalid JSON body: {e}", 400)
+
+    def arg(self, name: str, default: Optional[str] = None) -> Optional[str]:
+        vals = self.query.get(name)
+        return vals[0] if vals else default
+
+
+class Response:
+    """Explicit response when a handler needs a non-200 code or raw bytes."""
+
+    def __init__(self, body: Any = None, status: int = 200, content_type: str = "application/json"):
+        self.body = body
+        self.status = status
+        self.content_type = content_type
+
+
+class Router:
+    def __init__(self, name: str):
+        self.name = name
+        self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+        self.route("GET", "/health", lambda req: {"status": "ok", "service": name})
+
+    def route(self, method: str, pattern: str, handler: Handler) -> None:
+        regex = re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern)
+        self._routes.append((method.upper(), re.compile(f"^{regex}$"), handler))
+
+    def dispatch(self, method: str, path: str, query, body: bytes, headers) -> Response:
+        matched_path = False
+        for m, rx, handler in self._routes:
+            match = rx.match(path)
+            if match:
+                matched_path = True
+                if m == method:
+                    req = Request(method, path, match.groupdict(), query, body, headers)
+                    result = handler(req)
+                    if isinstance(result, Response):
+                        return result
+                    return Response(result if result is not None else {})
+        if matched_path:
+            raise KubeMLError(f"method {method} not allowed for {path}", 405)
+        raise KubeMLError(f"no route for {path}", 404)
+
+
+class Service:
+    """One HTTP service: a Router bound to a port, run on a daemon thread."""
+
+    def __init__(self, router: Router, host: str, port: int):
+        self.router = router
+        self.host = host
+        self.port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Service":
+        router = self.router
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route access logs into logging
+                log.debug("%s %s", router.name, fmt % args)
+
+            def _respond(self, resp: Response):
+                if isinstance(resp.body, (bytes, bytearray)):
+                    payload = bytes(resp.body)
+                else:
+                    payload = json.dumps(resp.body).encode()
+                self.send_response(resp.status)
+                self.send_header("Content-Type", resp.content_type)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _handle(self, method: str):
+                try:
+                    parsed = urlparse(self.path)
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length else b""
+                    resp = router.dispatch(
+                        method, parsed.path, parse_qs(parsed.query), body, self.headers
+                    )
+                except KubeMLError as e:
+                    resp = Response(e.to_dict(), status=e.status_code)
+                except BrokenPipeError:
+                    return
+                except Exception as e:  # generic 500 envelope (server.py:133-151)
+                    log.exception("%s: unhandled error on %s %s", router.name, method, self.path)
+                    resp = Response({"error": str(e), "code": 500}, status=500)
+                try:
+                    self._respond(resp)
+                except BrokenPipeError:
+                    pass
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def do_PUT(self):
+                self._handle("PUT")
+
+            def do_DELETE(self):
+                self._handle("DELETE")
+
+        self._server = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self._server.daemon_threads = True
+        if self.port == 0:
+            self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name=f"httpd-{self.router.name}", daemon=True
+        )
+        self._thread.start()
+        log.info("%s listening on %s:%d", self.router.name, self.host, self.port)
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

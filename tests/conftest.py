@@ -9,10 +9,19 @@ reference: ml/tests/integration.go:14-36).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU with 8 virtual devices regardless of the ambient platform: tests
+# always run on the virtual mesh; benchmarks use the real chip. The environment's
+# sitecustomize imports jax at interpreter startup, so env vars are too late here
+# — use jax.config (backends are not initialized until first device use).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np
 import pytest

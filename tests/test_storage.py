@@ -1,0 +1,177 @@
+"""Storage layer tests: shard store, history store, and the HTTP storage service."""
+
+import io
+import pickle
+
+import numpy as np
+import pytest
+import requests
+
+from kubeml_tpu.api.errors import (
+    DataError,
+    DatasetExistsError,
+    DatasetNotFoundError,
+    JobNotFoundError,
+)
+from kubeml_tpu.api.types import History
+from kubeml_tpu.storage import HistoryStore, ShardStore, StorageService
+
+from conftest import make_blobs
+
+
+@pytest.fixture
+def store(tmp_config):
+    return ShardStore(config=tmp_config)
+
+
+def _make(store, name="mnist", n_train=200, n_test=60):
+    xtr, ytr = make_blobs(n_train, seed=1)
+    xte, yte = make_blobs(n_test, seed=2)
+    store.create(name, xtr, ytr, xte, yte)
+    return xtr, ytr, xte, yte
+
+
+def test_create_get_summary(store):
+    xtr, ytr, xte, yte = _make(store)
+    h = store.get("mnist")
+    s = h.summary()
+    assert s.train_set_size == 200
+    assert s.test_set_size == 60
+    # 200 samples / 64 -> 4 logical subsets (ceil), matching reference doc counting
+    assert h.num_subsets("train") == 4
+    assert h.num_subsets("test") == 1
+
+
+def test_subset_range_contents(store):
+    xtr, ytr, _, _ = _make(store)
+    h = store.get("mnist")
+    x, y = h.load_subset_range("train", 1, 3)  # samples [64, 192)
+    np.testing.assert_array_equal(x, xtr[64:192])
+    np.testing.assert_array_equal(y, ytr[64:192])
+    # final partial subset
+    x, y = h.load_subset_range("train", 3, 4)
+    assert len(x) == 200 - 192
+
+
+def test_subset_range_empty_raises(store):
+    _make(store)
+    h = store.get("mnist")
+    with pytest.raises(DataError):
+        h.load_subset_range("train", 4, 4)
+    with pytest.raises(DataError):
+        h.load_subset_range("train", 10, 12)
+
+
+def test_duplicate_and_missing(store):
+    _make(store)
+    with pytest.raises(DatasetExistsError):
+        _make(store)
+    with pytest.raises(DatasetNotFoundError):
+        store.get("nope")
+    with pytest.raises(DatasetNotFoundError):
+        store.delete("nope")
+
+
+def test_delete_and_list(store):
+    _make(store, "a")
+    _make(store, "b")
+    assert [s.name for s in store.list()] == ["a", "b"]
+    store.delete("a")
+    assert [s.name for s in store.list()] == ["b"]
+
+
+def test_length_mismatch_rejected(store):
+    x, y = make_blobs(100)
+    with pytest.raises(DataError):
+        store.create("bad", x, y[:50], x, y)
+    assert not store.exists("bad")  # no partial dataset left behind
+
+
+def test_history_store_roundtrip(tmp_config):
+    hs = HistoryStore(config=tmp_config)
+    h = History(id="job1")
+    h.append_epoch(1.0, 4, 2.0, validation_loss=0.9, accuracy=50.0)
+    hs.save(h)
+    assert hs.get("job1").train_loss == [1.0]
+    assert len(hs.list()) == 1
+    with pytest.raises(JobNotFoundError):
+        hs.get("missing")
+    assert hs.prune() == 1
+    assert hs.list() == []
+
+
+# --- HTTP service ---
+
+
+def _npy_bytes(arr):
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
+
+
+@pytest.fixture
+def storage_svc(tmp_config):
+    svc = StorageService(config=tmp_config).start()
+    yield svc
+    svc.stop()
+
+
+def _upload_files(n_train=130, n_test=40, as_pickle=False):
+    xtr, ytr = make_blobs(n_train, seed=3)
+    xte, yte = make_blobs(n_test, seed=4)
+    enc = (lambda a: pickle.dumps(a)) if as_pickle else _npy_bytes
+    return {
+        "x-train": ("x.npy", enc(xtr)),
+        "y-train": ("y.npy", enc(ytr)),
+        "x-test": ("xt.npy", enc(xte)),
+        "y-test": ("yt.npy", enc(yte)),
+    }
+
+
+def test_service_upload_list_delete(storage_svc):
+    url = storage_svc.url
+    r = requests.post(f"{url}/dataset/cifar", files=_upload_files())
+    assert r.status_code == 200, r.text
+    assert r.json()["train_set_size"] == 130
+
+    r = requests.get(f"{url}/dataset")
+    assert [d["name"] for d in r.json()] == ["cifar"]
+
+    r = requests.get(f"{url}/dataset/cifar")
+    assert r.json()["test_set_size"] == 40
+
+    r = requests.delete(f"{url}/dataset/cifar")
+    assert r.status_code == 200
+    r = requests.get(f"{url}/dataset/cifar")
+    assert r.status_code == 404
+    assert set(r.json()) == {"error", "code"}
+
+
+def test_service_pickle_upload(storage_svc):
+    r = requests.post(f"{storage_svc.url}/dataset/pkl", files=_upload_files(as_pickle=True))
+    assert r.status_code == 200, r.text
+
+
+def test_service_missing_file_rejected(storage_svc):
+    files = _upload_files()
+    del files["y-test"]
+    r = requests.post(f"{storage_svc.url}/dataset/bad", files=files)
+    assert r.status_code == 400
+    assert "y-test" in r.json()["error"]
+
+
+def test_service_duplicate_rejected(storage_svc):
+    requests.post(f"{storage_svc.url}/dataset/dup", files=_upload_files())
+    r = requests.post(f"{storage_svc.url}/dataset/dup", files=_upload_files())
+    assert r.status_code == 400
+
+
+def test_service_garbage_payload_rejected(storage_svc):
+    files = {k: (n, b"not an array") for k, (n, _) in _upload_files().items()}
+    r = requests.post(f"{storage_svc.url}/dataset/garbage", files=files)
+    assert r.status_code == 400
+
+
+def test_service_health(storage_svc):
+    r = requests.get(f"{storage_svc.url}/health")
+    assert r.json()["status"] == "ok"
