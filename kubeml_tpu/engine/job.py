@@ -64,7 +64,7 @@ class TrainJob:
         self.parallelism = request.options.default_parallelism
         self.trainer = KAvgTrainer(
             model, precision=request.options.precision, devices=devices,
-            donate=request.options.donate,
+            donate=request.options.donate, mesh_shape=request.options.mesh_shape,
         )
         self.history = History(id=job_id, task={"request": request.to_dict()})
         self.stop_event = threading.Event()
@@ -102,6 +102,7 @@ class TrainJob:
             )
 
             val_acc = 0.0
+            acc_pct = None
             for epoch in range(req.epochs):
                 if self.stop_event.is_set():
                     log.info("%s: stop requested, exiting at epoch %d", self.job_id, epoch)
@@ -110,6 +111,8 @@ class TrainJob:
                 used_parallelism = self.parallelism
                 train_loss = self._train_epoch(epoch, handle, dataset)
                 elapsed = time.time() - t0
+                if self.stop_event.is_set() and np.isnan(train_loss):
+                    break  # stopped mid-epoch before any round completed
 
                 # elastic re-evaluation (job.go:196-215): ask the scheduler with
                 # this epoch's elapsed time unless parallelism is static
@@ -160,7 +163,7 @@ class TrainJob:
             # validate_every == 0 means the user opted out of validation entirely
             if (
                 opts.validate_every > 0
-                and self.history.accuracy == []
+                and acc_pct is None
                 and not self.stop_event.is_set()
             ):
                 val_acc, val_loss = self._validate(dataset, handle)
@@ -211,6 +214,8 @@ class TrainJob:
             )
             losses.append(loss)
         if not losses:
+            if self.stop_event.is_set():
+                return float("nan")  # graceful stop before any round completed
             raise KubeMLError(f"job {self.job_id}: epoch produced no rounds")
         # one blocking host read per epoch, not per round (keeps rounds async)
         return float(np.mean([float(l) for l in losses]))

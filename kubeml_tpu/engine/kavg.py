@@ -90,10 +90,15 @@ class KAvgTrainer:
         precision: str = "bf16",
         devices: Optional[List[jax.Device]] = None,
         donate: bool = True,
+        mesh_shape: Optional[Dict[str, int]] = None,
     ):
         self.model = model
         self.precision = precision
         self.devices = list(devices if devices is not None else jax.devices())
+        # TrainOptions.mesh_shape override: {"worker": d} caps the device count
+        # the worker axis may span (e.g. reserve chips for other jobs)
+        if mesh_shape and "worker" in mesh_shape:
+            self.devices = self.devices[: int(mesh_shape["worker"])]
         self.donate = donate
         self._train_cache: Dict[Tuple, Any] = {}
         self._eval_cache: Dict[Tuple, Any] = {}

@@ -47,7 +47,13 @@ def parse_multipart(body: bytes, content_type: str) -> Dict[str, bytes]:
 
 def decode_array(payload: bytes, field: str) -> np.ndarray:
     """Decode one uploaded file: .npy bytes or a pickled array/list
-    (reference storage accepts both, api.py:30-44 _load_dataset)."""
+    (reference storage accepts both, api.py:30-44 _load_dataset).
+
+    Trust boundary: the pickle fallback executes the payload's reducers, same as
+    the reference's pickle.load on uploads — the upload endpoint is operator-only
+    (cluster-internal in the reference deployment) and must not be exposed to
+    untrusted users. Prefer .npy uploads, which are decoded with
+    ``allow_pickle=False``."""
     if payload[:6] == b"\x93NUMPY":
         try:
             return np.load(io.BytesIO(payload), allow_pickle=False)

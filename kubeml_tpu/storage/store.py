@@ -129,6 +129,14 @@ class ShardStore:
                 )
             if len(x) == 0:
                 raise DataError(f"{split}: empty split")
+            # object arrays would np.save as pickles and break the mmap read
+            # path later (DatasetHandle._load uses allow_pickle=False) — reject
+            # ragged/object uploads at the door with a 400 instead
+            if x.dtype == object or y.dtype == object:
+                raise DataError(
+                    f"{split}: arrays must have a uniform numeric dtype "
+                    f"(got data={x.dtype}, labels={y.dtype})"
+                )
         path = self._path(name)
         # stage under a dot-dir with a unique suffix: concurrent creates of any
         # names never collide, and a crash mid-write leaves only hidden litter
