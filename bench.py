@@ -5,12 +5,10 @@ chip under the driver; CPU otherwise) and prints ONE JSON line:
 ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
 
 Headline metric (BASELINE.md target #2): data-parallel K-AVG training
-throughput in samples/sec on synthetic CIFAR-10-shaped data. ``vs_baseline``
-is measured samples/sec divided by the reference's effective per-GPU rate —
-the reference publishes no numeric throughput (BASELINE.md: figures only), so
-we normalize against a conservative single-GPU ResNet-34 CIFAR-10 figure of
-~1000 samples/sec (typical for torch 1.7 on a 2020-era K80/T4 class GPU the
-reference's CUDA 10.1 images targeted).
+throughput in samples/sec on synthetic data shaped like the flagship's input.
+``vs_baseline`` normalizes against a conservative reference single-GPU figure
+for the *same* model class (see kubeml_tpu.benchmarks.harness — the reference
+publishes no numeric throughput, only thesis figures).
 """
 
 from __future__ import annotations
@@ -21,56 +19,24 @@ import time
 import jax
 import numpy as np
 
-REFERENCE_SAMPLES_PER_SEC = 1000.0
-
-
-def pick_model(num_classes: int = 10):
-    """Flagship benchmark model: ResNet-18 when available, else LeNet."""
-    try:
-        from kubeml_tpu.models.resnet import ResNet18
-
-        return ResNet18(num_classes=num_classes), (32, 32, 3), "resnet18-cifar10"
-    except ImportError:
-        from kubeml_tpu.models.lenet import LeNet
-
-        return LeNet(num_classes=num_classes), (28, 28, 1), "lenet"
-
 
 def main():
-    from kubeml_tpu.runtime.model import KubeModel
-    from kubeml_tpu.data.dataset import KubeDataset
+    from kubeml_tpu.benchmarks.harness import flagship, make_synthetic_model
     from kubeml_tpu.engine.kavg import KAvgTrainer
 
-    module, sample_shape, name = pick_model()
+    fs = flagship()
+    model = make_synthetic_model(fs.module, "bench-synthetic")
 
-    class _BenchDataset(KubeDataset):
-        def __init__(self):
-            super().__init__("bench-synthetic")
-
-    class _BenchModel(KubeModel):
-        def __init__(self):
-            super().__init__(_BenchDataset())
-
-        def build(self):
-            return module
-
-        def configure_optimizers(self):
-            import optax
-
-            return optax.sgd(self.lr, momentum=0.9)
-
-    n_devices = len(jax.devices())
-    n_workers = max(1, n_devices)
+    n_workers = max(1, len(jax.devices()))
     batch = 128
     k = 8  # sync every 8 local steps (BASELINE target config)
     rounds = 8
 
-    model = _BenchModel()
     trainer = KAvgTrainer(model, precision="bf16")
     rng = jax.random.PRNGKey(0)
     r = np.random.default_rng(0)
-    x = r.normal(size=(n_workers, k, batch, *sample_shape)).astype(np.float32)
-    y = r.integers(0, 10, size=(n_workers, k, batch)).astype(np.int64)
+    x = r.normal(size=(n_workers, k, batch, *fs.sample_shape)).astype(np.float32)
+    y = r.integers(0, fs.num_classes, size=(n_workers, k, batch)).astype(np.int64)
     mask = np.ones((n_workers, k, batch), np.float32)
 
     variables = trainer.init_variables(rng, x[0, 0], n_workers)
@@ -92,10 +58,10 @@ def main():
     print(
         json.dumps(
             {
-                "metric": f"{name}-kavg-train-throughput",
+                "metric": f"{fs.name}-kavg-train-throughput",
                 "value": round(sps, 1),
                 "unit": "samples/sec",
-                "vs_baseline": round(sps / REFERENCE_SAMPLES_PER_SEC, 3),
+                "vs_baseline": round(sps / fs.baseline_sps, 3),
             }
         )
     )

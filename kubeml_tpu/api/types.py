@@ -102,6 +102,12 @@ class TrainOptions(_JsonMixin):
             raise ValueError("validate_every must be >= 0")
         if self.k == 0 or self.k < -1:
             raise ValueError("k must be -1 (sparse) or a positive step count")
+        if self.mesh_shape is not None:
+            for axis, size in self.mesh_shape.items():
+                if not isinstance(size, int) or size < 1:
+                    raise ValueError(
+                        f"mesh_shape[{axis!r}] must be a positive int, got {size!r}"
+                    )
 
 
 @dataclass

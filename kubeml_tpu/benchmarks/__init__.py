@@ -1,0 +1,3 @@
+from .harness import flagship, make_synthetic_model
+
+__all__ = ["flagship", "make_synthetic_model"]

@@ -1,0 +1,76 @@
+"""Shared benchmark/dryrun harness: flagship model selection + synthetic KubeModel.
+
+Used by both ``bench.py`` (driver benchmark) and ``__graft_entry__.py``
+(compile checks) so model selection and harness wiring cannot drift apart.
+
+``vs_baseline`` denominators: the reference publishes no numeric throughput
+(BASELINE.md — thesis figures only), so each flagship carries a conservative
+single-GPU samples/sec estimate for the reference's hardware class (CUDA
+10.1-era GPUs, torch 1.7: reference ml/environment/Dockerfile:1-31). A LeNet
+fallback is normalized against a LeNet figure, never a ResNet one.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class Flagship:
+    module: object
+    sample_shape: Tuple[int, ...]
+    name: str
+    num_classes: int
+    # conservative reference single-GPU throughput (samples/sec) for vs_baseline
+    baseline_sps: float
+
+
+def flagship() -> Flagship:
+    """The headline benchmark model: ResNet-18/CIFAR-10 when the resnet family
+    is available (BASELINE.md target #2), else LeNet/MNIST (target #1)."""
+    try:
+        from ..models.resnet import ResNet18
+
+        return Flagship(
+            module=ResNet18(num_classes=10),
+            sample_shape=(32, 32, 3),
+            name="resnet18-cifar10",
+            num_classes=10,
+            baseline_sps=1000.0,  # ResNet-class model, single 2020-era GPU
+        )
+    except ImportError:
+        from ..models.lenet import LeNet
+
+        return Flagship(
+            module=LeNet(num_classes=10),
+            sample_shape=(28, 28, 1),
+            name="lenet-mnist",
+            num_classes=10,
+            baseline_sps=20000.0,  # LeNet is tiny; GPUs push O(10k) samples/sec
+        )
+
+
+def make_synthetic_model(module, dataset_name: str = "synthetic"):
+    """Wrap a Flax module in a KubeModel over a placeholder dataset (the
+    harness feeds data directly, so the dataset is never attached)."""
+    import optax
+
+    from ..data.dataset import KubeDataset
+    from ..runtime.model import KubeModel
+
+    class _SyntheticDataset(KubeDataset):
+        def __init__(self):
+            super().__init__(dataset_name)
+
+    class _SyntheticModel(KubeModel):
+        def __init__(self):
+            super().__init__(_SyntheticDataset())
+
+        def build(self):
+            return module
+
+        def configure_optimizers(self):
+            return optax.sgd(self.lr, momentum=0.9)
+
+    return _SyntheticModel()

@@ -1,0 +1,292 @@
+"""kubeml CLI — command tree mirroring the reference's cobra CLI.
+
+Reference commands (reference: ml/pkg/kubeml-cli/cmd/root.go:7-17):
+``train`` (cmd/train.go:36-169 incl. --parallelism --static --K --sparse-avg
+--validate-every --goal-accuracy and batch<=1024 validation), ``infer``,
+``function create|delete|list`` (cmd/function.go), ``dataset create|delete|list``
+(cmd/dataset.go), ``task list|stop`` (cmd/task.go), ``history get|delete|list|
+prune`` (cmd/history.go), ``logs`` (cmd/log.go). Extra: ``start`` boots the
+all-in-one local cluster (no Helm/K8s here — the TPU VM is the cluster).
+
+Run as ``python -m kubeml_tpu.cli <command>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from .api.config import get_config
+from .api.errors import KubeMLError
+from .api.types import TrainOptions, TrainRequest
+
+
+def _client(args):
+    from .controller.client import KubemlClient
+
+    return KubemlClient(args.url)
+
+
+def _print(obj) -> None:
+    print(json.dumps(obj, indent=2, default=str))
+
+
+# --- train (reference cmd/train.go:36-169) ---
+
+
+def cmd_train(args) -> int:
+    if not (0 < args.batch <= 1024):
+        print("error: batch size must be in (0, 1024]", file=sys.stderr)
+        return 1
+    k = -1 if args.sparse_avg else args.k
+    req = TrainRequest(
+        model_type=args.function,
+        batch_size=args.batch,
+        epochs=args.epochs,
+        dataset=args.dataset,
+        lr=args.lr,
+        function_name=args.function,
+        options=TrainOptions(
+            default_parallelism=args.parallelism,
+            static_parallelism=args.static,
+            k=k,
+            validate_every=args.validate_every,
+            goal_accuracy=args.goal_accuracy,
+        ),
+    )
+    job_id = _client(args).networks().train(req)
+    print(job_id)
+    return 0
+
+
+def cmd_infer(args) -> int:
+    import numpy as np
+
+    data = np.load(args.datafile, allow_pickle=False)
+    preds = _client(args).networks().infer(args.network, data)
+    _print(preds)
+    return 0
+
+
+# --- dataset (reference cmd/dataset.go:49-86) ---
+
+
+def cmd_dataset(args) -> int:
+    c = _client(args).datasets()
+    if args.action == "create":
+        s = c.create(args.name, args.traindata, args.trainlabels, args.testdata, args.testlabels)
+        _print(s.to_dict())
+    elif args.action == "delete":
+        c.delete(args.name)
+        print(f"deleted {args.name}")
+    else:
+        _print([d.to_dict() for d in c.list()])
+    return 0
+
+
+# --- function (reference cmd/function.go:70-262) ---
+
+
+def cmd_function(args) -> int:
+    c = _client(args).functions()
+    if args.action == "create":
+        _print(c.create(args.name, args.code))
+    elif args.action == "delete":
+        c.delete(args.name)
+        print(f"deleted {args.name}")
+    else:
+        _print(c.list())
+    return 0
+
+
+# --- task (reference cmd/task.go:62-117) ---
+
+
+def cmd_task(args) -> int:
+    c = _client(args).tasks()
+    if args.action == "list":
+        tasks = c.list()
+        if args.short:
+            for t in tasks:
+                print(t.job_id)
+        else:
+            _print([t.to_dict() for t in tasks])
+    elif args.action == "stop":
+        c.stop(args.id)
+        print(f"stopped {args.id}")
+    return 0
+
+
+# --- history (reference cmd/history.go) ---
+
+
+def cmd_history(args) -> int:
+    c = _client(args).histories()
+    if args.action == "get":
+        _print(c.get(args.id).to_dict())
+    elif args.action == "delete":
+        c.delete(args.id)
+        print(f"deleted {args.id}")
+    elif args.action == "prune":
+        print(f"pruned {c.prune()} histories")
+    else:
+        _print([h.to_dict() for h in c.list()])
+    return 0
+
+
+# --- logs (reference cmd/log.go:28-66 shells to kubectl; ours tails the
+# cluster log file, filtered by job id) ---
+
+
+def cmd_logs(args) -> int:
+    cfg = get_config()
+    log_file = cfg.data_root / "logs" / "kubeml.log"
+    if not log_file.exists():
+        print(f"no log file at {log_file}", file=sys.stderr)
+        return 1
+
+    def matching_lines():
+        with open(log_file) as f:
+            for line in f:
+                if args.id is None or args.id in line:
+                    yield line.rstrip()
+
+    for line in matching_lines():
+        print(line)
+    if args.follow:
+        with open(log_file) as f:
+            f.seek(0, 2)
+            try:
+                while True:
+                    line = f.readline()
+                    if not line:
+                        time.sleep(0.5)
+                        continue
+                    if args.id is None or args.id in line:
+                        print(line.rstrip())
+            except KeyboardInterrupt:
+                pass
+    return 0
+
+
+# --- start: boot the all-in-one cluster ---
+
+
+def cmd_start(args) -> int:
+    import logging
+
+    cfg = get_config()
+    cfg.ensure_dirs()
+    log_dir = cfg.data_root / "logs"
+    log_dir.mkdir(parents=True, exist_ok=True)
+    logging.basicConfig(
+        level=logging.DEBUG if cfg.debug else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        handlers=[
+            logging.StreamHandler(),
+            logging.FileHandler(log_dir / "kubeml.log"),
+        ],
+    )
+    from .cluster import LocalCluster
+
+    with LocalCluster(config=cfg) as cluster:
+        print(f"kubeml-tpu cluster running; controller at {cluster.controller_url}")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            print("shutting down")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kubeml", description="kubeml-tpu CLI")
+    p.add_argument("--url", default=None, help="controller URL (default from config)")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    t = sub.add_parser("train", help="submit a train job")
+    t.add_argument("--function", "-f", required=True)
+    t.add_argument("--dataset", "-d", required=True)
+    t.add_argument("--epochs", "-e", type=int, default=1)
+    t.add_argument("--batch", "-b", type=int, default=64)
+    t.add_argument("--lr", type=float, default=0.01)
+    t.add_argument("--parallelism", "-p", type=int, default=4)
+    t.add_argument("--static", action="store_true", help="freeze parallelism")
+    t.add_argument("--k", "-K", type=int, default=16, help="K-AVG sync period")
+    t.add_argument("--sparse-avg", action="store_true", help="one sync per epoch (K=-1)")
+    t.add_argument("--validate-every", type=int, default=1)
+    t.add_argument("--goal-accuracy", type=float, default=100.0)
+    t.set_defaults(fn=cmd_train)
+
+    i = sub.add_parser("infer", help="run inference against a trained job")
+    i.add_argument("--network", "-n", required=True, help="job id of the model")
+    i.add_argument("--datafile", required=True, help=".npy file with inputs")
+    i.set_defaults(fn=cmd_infer)
+
+    d = sub.add_parser("dataset", help="manage datasets")
+    dsub = d.add_subparsers(dest="action", required=True)
+    dc = dsub.add_parser("create")
+    dc.add_argument("--name", "-n", required=True)
+    dc.add_argument("--traindata", required=True)
+    dc.add_argument("--trainlabels", required=True)
+    dc.add_argument("--testdata", required=True)
+    dc.add_argument("--testlabels", required=True)
+    dd = dsub.add_parser("delete")
+    dd.add_argument("--name", "-n", required=True)
+    dsub.add_parser("list")
+    d.set_defaults(fn=cmd_dataset)
+
+    f = sub.add_parser("function", aliases=["fn"], help="manage functions")
+    fsub = f.add_subparsers(dest="action", required=True)
+    fc = fsub.add_parser("create")
+    fc.add_argument("--name", "-n", required=True)
+    fc.add_argument("--code", required=True, help="path to the .py source file")
+    fd = fsub.add_parser("delete")
+    fd.add_argument("--name", "-n", required=True)
+    fsub.add_parser("list")
+    f.set_defaults(fn=cmd_function)
+
+    k = sub.add_parser("task", help="manage running tasks")
+    ksub = k.add_subparsers(dest="action", required=True)
+    kl = ksub.add_parser("list")
+    kl.add_argument("--short", action="store_true")
+    ks = ksub.add_parser("stop")
+    ks.add_argument("--id", required=True)
+    k.set_defaults(fn=cmd_task)
+
+    h = sub.add_parser("history", help="training histories")
+    hsub = h.add_subparsers(dest="action", required=True)
+    hg = hsub.add_parser("get")
+    hg.add_argument("--id", required=True)
+    hd = hsub.add_parser("delete")
+    hd.add_argument("--id", required=True)
+    hsub.add_parser("list")
+    hsub.add_parser("prune")
+    h.set_defaults(fn=cmd_history)
+
+    lg = sub.add_parser("logs", help="show cluster logs")
+    lg.add_argument("--id", default=None, help="filter by job id")
+    lg.add_argument("-f", "--follow", action="store_true")
+    lg.set_defaults(fn=cmd_logs)
+
+    s = sub.add_parser("start", help="boot the all-in-one local cluster")
+    s.set_defaults(fn=cmd_start)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except KubeMLError as e:
+        print(f"error: {e.message}", file=sys.stderr)
+        return 1
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,89 @@
+"""Cluster wiring — boot the full control plane in one process.
+
+The reference deploys five services as Kubernetes pods (Helm chart,
+reference: ml/charts/kubeml/) and also supports an all-goroutines debug boot
+(reference: ml/tests/integration.go:14-36 + DEBUG_ENV). On a TPU VM the
+all-in-one-process form is the *primary* deployment — the chips are local, so
+scattering the control plane over pods would only add hops. ``LocalCluster``
+wires storage + PS + scheduler + controller in-process (method calls, zero
+serialization) while still exposing every reference HTTP surface for remote
+clients and the CLI.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from .api.config import Config, get_config
+from .controller.controller import Controller
+from .functions.registry import FunctionRegistry
+from .ps.parameter_server import ParameterServer
+from .ps.transport import PSAPI
+from .scheduler.scheduler import Scheduler
+from .scheduler.transport import SchedulerAPI
+from .storage.history import HistoryStore
+from .storage.service import StorageService
+from .storage.store import ShardStore
+
+log = logging.getLogger("kubeml.cluster")
+
+
+class LocalCluster:
+    """All services in one process, shared stores, in-proc control flow."""
+
+    def __init__(self, config: Optional[Config] = None, devices=None, serve_http: bool = True):
+        self.cfg = config or get_config()
+        self.cfg.ensure_dirs()
+        self.serve_http = serve_http
+
+        self.store = ShardStore(config=self.cfg)
+        self.history_store = HistoryStore(config=self.cfg)
+        self.registry = FunctionRegistry(config=self.cfg)
+        self.ps = ParameterServer(
+            registry=self.registry,
+            store=self.store,
+            history_store=self.history_store,
+            config=self.cfg,
+            devices=devices,
+        )
+        self.scheduler = Scheduler(self.ps, config=self.cfg)
+        self.ps.bind_scheduler(self.scheduler)
+        self.controller = Controller(
+            self.scheduler,
+            self.ps,
+            store=self.store,
+            history_store=self.history_store,
+            registry=self.registry,
+            config=self.cfg,
+        )
+        self.storage_service: Optional[StorageService] = None
+        self.scheduler_api: Optional[SchedulerAPI] = None
+        self.ps_api: Optional[PSAPI] = None
+
+    def start(self) -> "LocalCluster":
+        self.scheduler.start()
+        if self.serve_http:
+            self.controller.start()
+            self.storage_service = StorageService(store=self.store, config=self.cfg).start()
+            self.scheduler_api = SchedulerAPI(self.scheduler, config=self.cfg).start()
+            self.ps_api = PSAPI(self.ps, config=self.cfg).start()
+            log.info("kubeml-tpu cluster up: controller at %s", self.controller.url)
+        return self
+
+    def stop(self) -> None:
+        self.scheduler.stop()
+        if self.serve_http:
+            for svc in (self.controller, self.storage_service, self.scheduler_api, self.ps_api):
+                if svc is not None:
+                    svc.stop()
+
+    @property
+    def controller_url(self) -> str:
+        return self.controller.url
+
+    def __enter__(self) -> "LocalCluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

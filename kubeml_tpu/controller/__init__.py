@@ -1,0 +1,4 @@
+from .controller import Controller
+from .client import KubemlClient
+
+__all__ = ["Controller", "KubemlClient"]

@@ -1,0 +1,185 @@
+"""KubemlClient — the typed Python SDK against the controller.
+
+Mirrors the reference's kubernetes-style Go client-set
+(reference: ml/pkg/controller/client/v1/v1.go:5-22):
+``client.networks().train/infer``, ``client.datasets().create/get/list/delete``
+(multipart upload of four files named x-train/y-train/x-test/y-test,
+reference v1/dataset.go:16-106), ``client.tasks().list/stop``,
+``client.histories().get/delete/list/prune``, plus ``client.functions()`` for
+the controller's function routes.
+"""
+
+from __future__ import annotations
+
+import io
+from pathlib import Path
+from typing import Any, List, Optional, Union
+
+import numpy as np
+import requests
+
+from ..api.errors import error_from_envelope
+from ..api.types import DatasetSummary, History, InferRequest, TrainRequest, TrainTask
+
+
+def _check(resp: requests.Response):
+    if resp.status_code >= 400:
+        raise error_from_envelope(resp.content, resp.status_code)
+    return resp.json()
+
+
+def _to_npy_bytes(a: Union[np.ndarray, str, Path, bytes]) -> bytes:
+    """Accept an array, a .npy/.pkl file path, or raw bytes."""
+    if isinstance(a, bytes):
+        return a
+    if isinstance(a, (str, Path)):
+        return Path(a).read_bytes()
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(a))
+    return buf.getvalue()
+
+
+class _Networks:
+    def __init__(self, client: "KubemlClient"):
+        self.c = client
+
+    def train(self, request: TrainRequest) -> str:
+        return _check(
+            requests.post(f"{self.c.url}/train", json=request.to_dict(), timeout=self.c.timeout)
+        )["id"]
+
+    def infer(self, model_id: str, data: Any) -> list:
+        body = InferRequest(model_id=model_id, data=np.asarray(data).tolist())
+        return _check(
+            requests.post(f"{self.c.url}/infer", json=body.to_dict(), timeout=self.c.timeout)
+        )["predictions"]
+
+
+class _Datasets:
+    def __init__(self, client: "KubemlClient"):
+        self.c = client
+
+    def create(self, name: str, x_train, y_train, x_test, y_test) -> DatasetSummary:
+        files = {
+            "x-train": ("x-train.npy", _to_npy_bytes(x_train)),
+            "y-train": ("y-train.npy", _to_npy_bytes(y_train)),
+            "x-test": ("x-test.npy", _to_npy_bytes(x_test)),
+            "y-test": ("y-test.npy", _to_npy_bytes(y_test)),
+        }
+        return DatasetSummary.from_dict(
+            _check(
+                requests.post(
+                    f"{self.c.url}/dataset/{name}", files=files, timeout=self.c.timeout
+                )
+            )
+        )
+
+    def get(self, name: str) -> DatasetSummary:
+        return DatasetSummary.from_dict(
+            _check(requests.get(f"{self.c.url}/dataset/{name}", timeout=self.c.timeout))
+        )
+
+    def list(self) -> List[DatasetSummary]:
+        return [
+            DatasetSummary.from_dict(d)
+            for d in _check(requests.get(f"{self.c.url}/dataset", timeout=self.c.timeout))
+        ]
+
+    def delete(self, name: str) -> None:
+        _check(requests.delete(f"{self.c.url}/dataset/{name}", timeout=self.c.timeout))
+
+
+class _Tasks:
+    def __init__(self, client: "KubemlClient"):
+        self.c = client
+
+    def list(self) -> List[TrainTask]:
+        return [
+            TrainTask.from_dict(d)
+            for d in _check(requests.get(f"{self.c.url}/tasks", timeout=self.c.timeout))
+        ]
+
+    def stop(self, job_id: str) -> None:
+        _check(requests.delete(f"{self.c.url}/tasks/{job_id}", timeout=self.c.timeout))
+
+
+class _Histories:
+    def __init__(self, client: "KubemlClient"):
+        self.c = client
+
+    def get(self, job_id: str) -> History:
+        return History.from_dict(
+            _check(requests.get(f"{self.c.url}/history/{job_id}", timeout=self.c.timeout))
+        )
+
+    def list(self) -> List[History]:
+        return [
+            History.from_dict(d)
+            for d in _check(requests.get(f"{self.c.url}/history", timeout=self.c.timeout))
+        ]
+
+    def delete(self, job_id: str) -> None:
+        _check(requests.delete(f"{self.c.url}/history/{job_id}", timeout=self.c.timeout))
+
+    def prune(self) -> int:
+        return _check(requests.delete(f"{self.c.url}/history", timeout=self.c.timeout))["pruned"]
+
+
+class _Functions:
+    def __init__(self, client: "KubemlClient"):
+        self.c = client
+
+    def create(self, name: str, source: Union[str, Path]) -> dict:
+        if isinstance(source, Path) or (isinstance(source, str) and source.endswith(".py")):
+            source = Path(source).read_text()
+        return _check(
+            requests.post(
+                f"{self.c.url}/function/{name}",
+                data=source.encode(),
+                headers={"Content-Type": "text/x-python"},
+                timeout=self.c.timeout,
+            )
+        )
+
+    def get(self, name: str) -> dict:
+        return _check(requests.get(f"{self.c.url}/function/{name}", timeout=self.c.timeout))
+
+    def list(self) -> List[dict]:
+        return _check(requests.get(f"{self.c.url}/function", timeout=self.c.timeout))
+
+    def delete(self, name: str) -> None:
+        _check(requests.delete(f"{self.c.url}/function/{name}", timeout=self.c.timeout))
+
+
+class KubemlClient:
+    """``KubemlClient(url)``; default URL from config (reference discovers the
+    controller from the k8s service, client/util.go:18-63 — here it's config)."""
+
+    def __init__(self, url: Optional[str] = None, timeout: float = 120.0):
+        if url is None:
+            from ..api.config import get_config
+
+            url = get_config().controller_url
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def networks(self) -> _Networks:
+        return _Networks(self)
+
+    def datasets(self) -> _Datasets:
+        return _Datasets(self)
+
+    def tasks(self) -> _Tasks:
+        return _Tasks(self)
+
+    def histories(self) -> _Histories:
+        return _Histories(self)
+
+    def functions(self) -> _Functions:
+        return _Functions(self)
+
+    def health(self) -> bool:
+        try:
+            return requests.get(f"{self.url}/health", timeout=5).status_code == 200
+        except requests.RequestException:
+            return False

@@ -1,0 +1,159 @@
+"""Controller — the REST gateway users and the CLI talk to.
+
+Route contract mirrors the reference controller
+(reference: ml/pkg/controller/api.go:16-42): ``/train`` ``/infer``
+``/dataset[...]`` ``/tasks[...]`` ``/history[...]`` ``/health``, with dataset
+GET/list served from store manifests (the reference counts Mongo docs,
+controller/storageApi.go:70-189) and upload/delete handled by the storage layer
+(reference reverse-proxies to the storage service, storageApi.go:35-67).
+
+Extension over the reference: ``/function`` CRUD. The reference CLI creates
+functions directly against Fission CRDs (cmd/function.go:70-262); with no
+Fission here, function deployment is a first-class controller route instead.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.config import Config, get_config
+from ..api.errors import KubeMLError
+from ..api.types import InferRequest, TrainRequest
+from ..functions.registry import FunctionRegistry
+from ..storage.history import HistoryStore
+from ..storage.service import REQUIRED_FILES, decode_array, parse_multipart
+from ..storage.store import ShardStore
+from ..utils.httpd import Request, Router, Service
+
+
+class Controller:
+    def __init__(
+        self,
+        scheduler,
+        ps,
+        store: Optional[ShardStore] = None,
+        history_store: Optional[HistoryStore] = None,
+        registry: Optional[FunctionRegistry] = None,
+        config: Optional[Config] = None,
+    ):
+        self.cfg = config or get_config()
+        self.scheduler = scheduler
+        self.ps = ps
+        self.store = store or ShardStore(config=self.cfg)
+        self.history_store = history_store or HistoryStore(config=self.cfg)
+        self.registry = registry or FunctionRegistry(config=self.cfg)
+
+        router = Router("controller")
+        router.route("POST", "/train", self._train)
+        router.route("POST", "/infer", self._infer)
+        router.route("GET", "/dataset", self._dataset_list)
+        router.route("GET", "/dataset/{name}", self._dataset_get)
+        router.route("POST", "/dataset/{name}", self._dataset_create)
+        router.route("DELETE", "/dataset/{name}", self._dataset_delete)
+        router.route("GET", "/tasks", self._tasks)
+        router.route("DELETE", "/tasks/{id}", self._task_stop)
+        router.route("GET", "/history", self._history_list)
+        router.route("GET", "/history/{id}", self._history_get)
+        router.route("DELETE", "/history/{id}", self._history_delete)
+        router.route("DELETE", "/history", self._history_prune)
+        router.route("GET", "/function", self._fn_list)
+        router.route("GET", "/function/{name}", self._fn_get)
+        router.route("POST", "/function/{name}", self._fn_create)
+        router.route("DELETE", "/function/{name}", self._fn_delete)
+        self.service = Service(router, self.cfg.host, self.cfg.controller_port)
+
+    # --- train / infer (reference networkApi.go:12-72) ---
+
+    def _train(self, req: Request):
+        train_req = TrainRequest.from_dict(req.json() or {})
+        # reference CLI validates dataset+function existence before submitting
+        # (cmd/train.go:87-119); the gateway enforces it for all clients
+        if not self.store.exists(train_req.dataset):
+            raise KubeMLError(f"dataset {train_req.dataset!r} not found", 404)
+        if not self.registry.exists(train_req.function_name):
+            raise KubeMLError(f"function {train_req.function_name!r} not found", 404)
+        return {"id": self.scheduler.submit_train(train_req)}
+
+    def _infer(self, req: Request):
+        body = InferRequest.from_dict(req.json() or {})
+        return {"predictions": self.scheduler.infer(body.model_id, body.data)}
+
+    # --- datasets (reference storageApi.go) ---
+
+    def _dataset_list(self, req: Request):
+        return [s.to_dict() for s in self.store.list()]
+
+    def _dataset_get(self, req: Request):
+        return self.store.get(req.params["name"]).summary().to_dict()
+
+    def _dataset_create(self, req: Request):
+        files = parse_multipart(req.body, req.headers.get("Content-Type", ""))
+        missing = [f for f in REQUIRED_FILES if f not in files]
+        if missing:
+            raise KubeMLError(f"missing upload files: {missing}", 400)
+        arrays = {f: decode_array(files[f], f) for f in REQUIRED_FILES}
+        return self.store.create(
+            req.params["name"],
+            x_train=arrays["x-train"],
+            y_train=arrays["y-train"],
+            x_test=arrays["x-test"],
+            y_test=arrays["y-test"],
+        ).to_dict()
+
+    def _dataset_delete(self, req: Request):
+        self.store.delete(req.params["name"])
+        return {"deleted": req.params["name"]}
+
+    # --- tasks (reference tasksApi.go:10-36) ---
+
+    def _tasks(self, req: Request):
+        return [t.to_dict() for t in self.ps.list_tasks()]
+
+    def _task_stop(self, req: Request):
+        self.ps.stop_task(req.params["id"])
+        return {}
+
+    # --- history (reference historyApi.go:14-111) ---
+
+    def _history_list(self, req: Request):
+        return [h.to_dict() for h in self.history_store.list()]
+
+    def _history_get(self, req: Request):
+        return self.history_store.get(req.params["id"]).to_dict()
+
+    def _history_delete(self, req: Request):
+        self.history_store.delete(req.params["id"])
+        return {}
+
+    def _history_prune(self, req: Request):
+        return {"pruned": self.history_store.prune()}
+
+    # --- functions ---
+
+    def _fn_list(self, req: Request):
+        return [f.to_dict() for f in self.registry.list()]
+
+    def _fn_get(self, req: Request):
+        return self.registry.summary(req.params["name"]).to_dict()
+
+    def _fn_create(self, req: Request):
+        if not req.body:
+            raise KubeMLError("empty function source", 400)
+        return self.registry.create(req.params["name"], req.body.decode()).to_dict()
+
+    def _fn_delete(self, req: Request):
+        self.registry.delete(req.params["name"])
+        return {"deleted": req.params["name"]}
+
+    # --- lifecycle ---
+
+    def start(self) -> "Controller":
+        self.service.start()
+        return self
+
+    def stop(self) -> None:
+        self.service.stop()
+
+    @property
+    def url(self) -> str:
+        return self.service.url

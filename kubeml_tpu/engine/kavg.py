@@ -98,7 +98,10 @@ class KAvgTrainer:
         # TrainOptions.mesh_shape override: {"worker": d} caps the device count
         # the worker axis may span (e.g. reserve chips for other jobs)
         if mesh_shape and "worker" in mesh_shape:
-            self.devices = self.devices[: int(mesh_shape["worker"])]
+            cap = mesh_shape["worker"]
+            if not isinstance(cap, int) or cap < 1:
+                raise ValueError(f"mesh_shape['worker'] must be a positive int, got {cap!r}")
+            self.devices = self.devices[:cap]
         self.donate = donate
         self._train_cache: Dict[Tuple, Any] = {}
         self._eval_cache: Dict[Tuple, Any] = {}

@@ -1,0 +1,3 @@
+from .registry import FunctionRegistry, FunctionSummary
+
+__all__ = ["FunctionRegistry", "FunctionSummary"]

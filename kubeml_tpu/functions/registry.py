@@ -1,0 +1,153 @@
+"""Function registry — "deploy a .py file" without serverless infrastructure.
+
+The reference packages a single user source file as a Fission Package + Function
++ HTTPTrigger (reference: ml/pkg/kubeml-cli/cmd/function.go:70-262, literal
+archive capped at Fission's ArchiveLiteralSizeLimit), and the Fission router
+specializes pooled pods that import the module and call its ``main``
+(reference: ml/environment/server.py:60-128).
+
+TPU-native equivalent: the registry stores the user's source under the data
+root; "invocation" imports the module in-process on the resident TPU worker —
+specialization cost becomes the jit-compile cache, not a pod cold start. The
+user contract is richer than the reference's (a KubeModel subclass instead of a
+torch ABC) but equally minimal: the file must define either ``main()`` returning
+a :class:`KubeModel` or exactly one KubeModel subclass constructible with no
+arguments.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import sys
+import time
+import uuid
+from dataclasses import dataclass
+from pathlib import Path
+from typing import List, Optional
+
+from ..api.config import Config, get_config
+from ..api.errors import FunctionNotFoundError, KubeMLError
+
+# Single-file source limit, mirroring Fission's literal archive limit the
+# reference CLI enforces (cmd/function.go:146-225); 256 KiB like fission's.
+MAX_SOURCE_BYTES = 256 * 1024
+
+
+@dataclass
+class FunctionSummary:
+    name: str
+    size: int
+    created_at: float
+
+    def to_dict(self):
+        return {"name": self.name, "size": self.size, "created_at": self.created_at}
+
+
+class FunctionRegistry:
+    """Filesystem registry: ``<functions_dir>/<name>.py``."""
+
+    def __init__(self, root: Optional[Path] = None, config: Optional[Config] = None):
+        cfg = config or get_config()
+        self.root = Path(root) if root is not None else cfg.functions_dir
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, name: str) -> Path:
+        if not name or "/" in name or name.startswith("."):
+            raise KubeMLError(f"invalid function name {name!r}", 400)
+        return self.root / f"{name}.py"
+
+    def exists(self, name: str) -> bool:
+        return self._path(name).exists()
+
+    def create(self, name: str, source: str, validate: bool = True) -> FunctionSummary:
+        if len(source.encode()) > MAX_SOURCE_BYTES:
+            raise KubeMLError(
+                f"function source exceeds {MAX_SOURCE_BYTES} bytes (single-file limit)", 400
+            )
+        path = self._path(name)
+        if path.exists():
+            raise KubeMLError(f"function {name!r} already exists", 400)
+        path.write_text(source)
+        if validate:
+            try:
+                self.load(name)
+            except Exception:
+                path.unlink(missing_ok=True)
+                raise
+        return self.summary(name)
+
+    def delete(self, name: str) -> None:
+        path = self._path(name)
+        if not path.exists():
+            raise FunctionNotFoundError(name)
+        path.unlink()
+
+    def summary(self, name: str) -> FunctionSummary:
+        path = self._path(name)
+        if not path.exists():
+            raise FunctionNotFoundError(name)
+        st = path.stat()
+        return FunctionSummary(name=name, size=st.st_size, created_at=st.st_mtime)
+
+    def list(self) -> List[FunctionSummary]:
+        return [
+            self.summary(p.stem)
+            for p in sorted(self.root.glob("*.py"))
+            if not p.name.startswith(".")
+        ]
+
+    def read_source(self, name: str) -> str:
+        path = self._path(name)
+        if not path.exists():
+            raise FunctionNotFoundError(name)
+        return path.read_text()
+
+    # --- specialization (reference: server.py:60-106 dynamic module load) ---
+
+    def load(self, name: str):
+        """Import the function module fresh and build its KubeModel.
+
+        A unique module name per load keeps concurrent jobs isolated from each
+        other's module state (the reference gets isolation from per-pod
+        specialization)."""
+        from ..runtime.model import KubeModel
+
+        path = self._path(name)
+        if not path.exists():
+            raise FunctionNotFoundError(name)
+        mod_name = f"kubeml_fn_{name}_{uuid.uuid4().hex[:8]}"
+        spec = importlib.util.spec_from_file_location(mod_name, path)
+        module = importlib.util.module_from_spec(spec)
+        sys.modules[mod_name] = module
+        try:
+            spec.loader.exec_module(module)
+        except Exception as e:
+            sys.modules.pop(mod_name, None)
+            raise KubeMLError(f"function {name!r} failed to import: {e}", 400) from e
+
+        main = getattr(module, "main", None)
+        if callable(main):
+            model = main()
+            if not isinstance(model, KubeModel):
+                raise KubeMLError(
+                    f"function {name!r}: main() must return a KubeModel, got {type(model).__name__}",
+                    400,
+                )
+            return model
+
+        candidates = [
+            v
+            for v in vars(module).values()
+            if isinstance(v, type)
+            and issubclass(v, KubeModel)
+            and v is not KubeModel
+            and v.__module__ == mod_name
+        ]
+        if len(candidates) != 1:
+            raise KubeMLError(
+                f"function {name!r} must define main() or exactly one KubeModel "
+                f"subclass (found {len(candidates)})",
+                400,
+            )
+        return candidates[0]()

@@ -1,0 +1,4 @@
+from .metrics import MetricsRegistry
+from .parameter_server import ParameterServer
+
+__all__ = ["ParameterServer", "MetricsRegistry"]

@@ -1,0 +1,76 @@
+"""Prometheus-format job metrics.
+
+Same metric names and label scheme as the reference's parameter-server gauges
+(reference: ml/pkg/ps/metrics.go:33-86): per-job gauges labeled ``jobid`` plus a
+running-jobs gauge labeled ``type``; updated each epoch/validation and cleared
+when the job finishes (metrics.go:90-133). Rendered in the Prometheus text
+exposition format on ``/metrics`` with no client-library dependency.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Tuple
+
+from ..api.types import MetricUpdate
+
+GAUGES = {
+    "kubeml_job_validation_loss": "Validation loss of a train job",
+    "kubeml_job_validation_accuracy": "Validation accuracy of a train job",
+    "kubeml_job_train_loss": "Train loss of a train job",
+    "kubeml_job_parallelism": "Parallelism of a train job",
+    "kubeml_job_epoch_duration_seconds": "Duration of the last epoch",
+}
+RUNNING = "kubeml_job_running_total"
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # {(metric, jobid): value}
+        self._values: Dict[Tuple[str, str], float] = {}
+        self._running: Dict[str, int] = {"train": 0, "inference": 0}
+
+    def update(self, u: MetricUpdate) -> None:
+        """Per-epoch push from a job (reference: metrics.go:90-98)."""
+        with self._lock:
+            jid = u.job_id
+            self._values[("kubeml_job_validation_loss", jid)] = u.validation_loss
+            self._values[("kubeml_job_validation_accuracy", jid)] = u.accuracy
+            self._values[("kubeml_job_train_loss", jid)] = u.train_loss
+            self._values[("kubeml_job_parallelism", jid)] = float(u.parallelism)
+            self._values[("kubeml_job_epoch_duration_seconds", jid)] = u.epoch_duration
+
+    def clear(self, job_id: str) -> None:
+        """Drop a finished job's series (reference: metrics.go:100-106)."""
+        with self._lock:
+            for key in [k for k in self._values if k[1] == job_id]:
+                del self._values[key]
+
+    def task_started(self, kind: str = "train") -> None:
+        with self._lock:
+            self._running[kind] = self._running.get(kind, 0) + 1
+
+    def task_finished(self, kind: str = "train") -> None:
+        with self._lock:
+            self._running[kind] = max(0, self._running.get(kind, 0) - 1)
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        with self._lock:
+            lines = []
+            for metric, help_text in GAUGES.items():
+                series = [(jid, v) for (m, jid), v in self._values.items() if m == metric]
+                lines.append(f"# HELP {metric} {help_text}")
+                lines.append(f"# TYPE {metric} gauge")
+                for jid, v in sorted(series):
+                    lines.append(f'{metric}{{jobid="{jid}"}} {v}')
+            lines.append(f"# HELP {RUNNING} Number of running tasks")
+            lines.append(f"# TYPE {RUNNING} gauge")
+            for kind, n in sorted(self._running.items()):
+                lines.append(f'{RUNNING}{{type="{kind}"}} {n}')
+            return "\n".join(lines) + "\n"
+
+    def get(self, metric: str, job_id: str) -> float:
+        with self._lock:
+            return self._values[(metric, job_id)]

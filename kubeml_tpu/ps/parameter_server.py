@@ -1,0 +1,221 @@
+"""Parameter Server — job lifecycle manager.
+
+The reference PS keeps an index of live train tasks, starts each one as a
+dedicated job pod (or an in-process goroutine in threaded mode), routes
+scheduler parallelism updates to the right job, and cleans up on finish
+(reference: ml/pkg/ps/parameter_server.go:45-105, api.go:72-327,
+job_pod.go:96-217). "Parameter server" is in name only there as here: weights
+are exchanged by averaging, not gradient pushes (SURVEY §2.4).
+
+TPU-native shape: jobs run as in-process threads next to the device mesh — the
+generalization of the reference's threaded mode (ps/api.go:211-217), which is
+the right default when the "cluster" is one TPU VM / slice. The epoch-end
+elastic round-trip (job -> scheduler -> PS -> job) is preserved: the job thread
+blocks in ``on_epoch_end`` until :meth:`update_task` delivers the scheduler's
+answer, exactly like the reference job's ``schedulerCh``
+(ml/pkg/train/job.go:196-215, ps/api.go:72-119).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..api.config import Config, get_config
+from ..api.errors import JobNotFoundError, KubeMLError
+from ..api.types import JobState, JobStateEnum, MetricUpdate, TrainTask
+from ..engine.job import TrainJob
+from ..functions.registry import FunctionRegistry
+from ..storage.history import HistoryStore
+from ..storage.store import ShardStore
+from .metrics import MetricsRegistry
+
+log = logging.getLogger("kubeml.ps")
+
+# Seconds the job thread waits for the scheduler's parallelism answer before
+# keeping its current parallelism (the reference blocks forever on schedulerCh;
+# a timeout keeps a dead scheduler from wedging training).
+UPDATE_TIMEOUT = 30.0
+
+
+@dataclass
+class _UpdateBox:
+    """One pending epoch-end answer (the job's schedulerCh)."""
+
+    event: threading.Event = field(default_factory=threading.Event)
+    parallelism: int = 0
+
+
+@dataclass
+class _JobRecord:
+    task: TrainTask
+    job: TrainJob
+    thread: threading.Thread
+    update_box: Optional[_UpdateBox] = None
+
+
+class ParameterServer:
+    def __init__(
+        self,
+        registry: Optional[FunctionRegistry] = None,
+        store: Optional[ShardStore] = None,
+        history_store: Optional[HistoryStore] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        config: Optional[Config] = None,
+        devices=None,
+    ):
+        self.cfg = config or get_config()
+        self.registry = registry or FunctionRegistry(config=self.cfg)
+        self.store = store or ShardStore(config=self.cfg)
+        self.history_store = history_store or HistoryStore(config=self.cfg)
+        self.metrics = metrics or MetricsRegistry()
+        self.devices = devices
+        self.scheduler = None  # bound after construction (circular dep)
+        self._jobs: Dict[str, _JobRecord] = {}
+        self._lock = threading.RLock()
+
+    def bind_scheduler(self, scheduler) -> None:
+        self.scheduler = scheduler
+
+    # --- task lifecycle (reference routes ps/api.go:335-345) ---
+
+    def start_task(self, task: TrainTask) -> None:
+        """`/start`: spin up the job (reference api.go:139-222)."""
+        req = task.parameters
+        with self._lock:
+            if task.job_id in self._jobs:
+                raise KubeMLError(f"job {task.job_id} already exists", 400)
+        model = self.registry.load(req.function_name)
+        model._set_params(
+            lr=req.lr, batch_size=req.batch_size, epoch=0, k=req.options.k, task="train"
+        )
+        req.options.default_parallelism = task.state.parallelism or req.options.default_parallelism
+        job = TrainJob(
+            task.job_id,
+            req,
+            model,
+            store=self.store,
+            history_store=self.history_store,
+            on_epoch_end=lambda state, jid=task.job_id: self._epoch_end(jid, state),
+            on_metrics=self.metrics.update,
+            devices=self.devices,
+        )
+        thread = threading.Thread(
+            target=self._run_job, args=(task, job), name=f"job-{task.job_id}", daemon=True
+        )
+        record = _JobRecord(task=task, job=job, thread=thread)
+        with self._lock:
+            self._jobs[task.job_id] = record
+        task.status = JobStateEnum.RUNNING
+        self.metrics.task_started("train")
+        thread.start()
+
+    def _run_job(self, task: TrainTask, job: TrainJob) -> None:
+        try:
+            job.train()
+            task.status = (
+                JobStateEnum.STOPPED if job.stop_event.is_set() else JobStateEnum.FINISHED
+            )
+        except Exception as e:
+            task.status = JobStateEnum.FAILED
+            log.error("job %s failed: %s", task.job_id, e)
+        finally:
+            self._finish(task.job_id)
+
+    def _finish(self, job_id: str) -> None:
+        """Job teardown (reference api.go:266-327): clear metrics, notify the
+        scheduler, drop the index entry."""
+        self.metrics.clear(job_id)
+        self.metrics.task_finished("train")
+        if self.scheduler is not None:
+            try:
+                self.scheduler.finish_job(job_id)
+            except Exception:
+                log.exception("notifying scheduler of %s finish failed", job_id)
+        with self._lock:
+            record = self._jobs.pop(job_id, None)
+        if record is not None and record.update_box is not None:
+            # unblock a job thread stuck waiting for a scheduler answer
+            record.update_box.event.set()
+
+    # --- elastic round-trip ---
+
+    def _epoch_end(self, job_id: str, state: JobState) -> int:
+        """Runs on the job thread: ask the scheduler, wait for update_task."""
+        if self.scheduler is None:
+            return state.parallelism
+        with self._lock:
+            record = self._jobs.get(job_id)
+            if record is None:
+                return state.parallelism
+            box = _UpdateBox(parallelism=state.parallelism)
+            record.update_box = box
+            task = record.task
+        task.state = state
+        self.scheduler.update_job(task)
+        if not box.event.wait(UPDATE_TIMEOUT):
+            log.warning("job %s: scheduler update timed out, keeping parallelism %d",
+                        job_id, state.parallelism)
+            return state.parallelism
+        return box.parallelism
+
+    def update_task(self, job_id: str, parallelism: int) -> None:
+        """`/update/{jobId}`: scheduler's answer routed to the job (api.go:72-119)."""
+        with self._lock:
+            record = self._jobs.get(job_id)
+        if record is None:
+            raise JobNotFoundError(job_id)
+        box = record.update_box
+        if box is None:
+            log.warning("job %s: update with no pending epoch-end request", job_id)
+            return
+        box.parallelism = parallelism
+        box.event.set()
+
+    # --- queries / control ---
+
+    def list_tasks(self) -> List[TrainTask]:
+        """`/tasks` (reference tasksApi proxies here)."""
+        with self._lock:
+            return [r.task for r in self._jobs.values()]
+
+    def get_task(self, job_id: str) -> TrainTask:
+        with self._lock:
+            record = self._jobs.get(job_id)
+        if record is None:
+            raise JobNotFoundError(job_id)
+        return record.task
+
+    def stop_task(self, job_id: str) -> None:
+        """`/stop/{jobId}` -> job stop flag (reference train/api.go:129-134)."""
+        with self._lock:
+            record = self._jobs.get(job_id)
+        if record is None:
+            raise JobNotFoundError(job_id)
+        record.job.stop()
+
+    def wait(self, job_id: str, timeout: Optional[float] = None) -> bool:
+        """Join a job's thread (test/CLI convenience; reference polls task list)."""
+        with self._lock:
+            record = self._jobs.get(job_id)
+        if record is None:
+            return True
+        record.thread.join(timeout)
+        return not record.thread.is_alive()
+
+    def infer(self, model_id: str, data) -> list:
+        """`/infer` serving path: run the (live) job's current model."""
+        with self._lock:
+            record = self._jobs.get(model_id)
+        if record is None:
+            raise JobNotFoundError(model_id)
+        self.metrics.task_started("inference")
+        try:
+            return np.asarray(record.job.infer(np.asarray(data))).tolist()
+        finally:
+            self.metrics.task_finished("inference")

@@ -1,0 +1,119 @@
+"""HTTP facade + client for the parameter server.
+
+Route contract mirrors the reference PS API (reference: ml/pkg/ps/api.go:335-345):
+``/start`` ``/update/{jobId}`` ``/metrics/{jobId}`` ``/finish/{jobId}``
+``/stop/{jobId}`` ``/tasks`` ``/health``, plus Prometheus exposition on
+``/metrics`` (reference serves it on :8080, ps/parameter_server.go:57-66).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import requests
+
+from ..api.config import Config, get_config
+from ..api.errors import error_from_envelope
+from ..api.types import TrainTask
+from ..utils.httpd import Request, Response, Router, Service
+from .parameter_server import ParameterServer
+
+
+class PSAPI:
+    def __init__(self, ps: ParameterServer, config: Optional[Config] = None):
+        self.cfg = config or get_config()
+        self.ps = ps
+        router = Router("ps")
+        router.route("POST", "/start", self._start)
+        router.route("POST", "/update/{jobId}", self._update)
+        router.route("POST", "/infer", self._infer)
+        router.route("DELETE", "/stop/{jobId}", self._stop)
+        router.route("GET", "/tasks", self._tasks)
+        router.route("GET", "/metrics", self._metrics)
+        self.service = Service(router, self.cfg.host, self.cfg.ps_port)
+
+    def _start(self, req: Request):
+        self.ps.start_task(TrainTask.from_dict(req.json() or {}))
+        return {}
+
+    def _update(self, req: Request):
+        body = req.json() or {}
+        self.ps.update_task(req.params["jobId"], int(body["parallelism"]))
+        return {}
+
+    def _infer(self, req: Request):
+        body = req.json() or {}
+        return {"predictions": self.ps.infer(body["model_id"], body["data"])}
+
+    def _stop(self, req: Request):
+        self.ps.stop_task(req.params["jobId"])
+        return {}
+
+    def _tasks(self, req: Request):
+        return [t.to_dict() for t in self.ps.list_tasks()]
+
+    def _metrics(self, req: Request):
+        return Response(
+            self.ps.metrics.render().encode(), content_type="text/plain; version=0.0.4"
+        )
+
+    def start(self) -> "PSAPI":
+        self.service.start()
+        return self
+
+    def stop(self) -> None:
+        self.service.stop()
+
+    @property
+    def url(self) -> str:
+        return self.service.url
+
+
+def _check(resp: requests.Response):
+    if resp.status_code >= 400:
+        raise error_from_envelope(resp.content, resp.status_code)
+    return resp.json()
+
+
+class PSClient:
+    """Remote PS with the method surface the scheduler/controller use."""
+
+    def __init__(self, url: str, timeout: float = 60.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def start_task(self, task: TrainTask) -> None:
+        _check(requests.post(f"{self.url}/start", json=task.to_dict(), timeout=self.timeout))
+
+    def update_task(self, job_id: str, parallelism: int) -> None:
+        _check(
+            requests.post(
+                f"{self.url}/update/{job_id}",
+                json={"parallelism": parallelism},
+                timeout=self.timeout,
+            )
+        )
+
+    def infer(self, model_id: str, data):
+        return _check(
+            requests.post(
+                f"{self.url}/infer",
+                json={"model_id": model_id, "data": data},
+                timeout=self.timeout,
+            )
+        )["predictions"]
+
+    def stop_task(self, job_id: str) -> None:
+        _check(requests.delete(f"{self.url}/stop/{job_id}", timeout=self.timeout))
+
+    def list_tasks(self):
+        return [TrainTask.from_dict(d) for d in _check(requests.get(f"{self.url}/tasks", timeout=self.timeout))]
+
+    def metrics_text(self) -> str:
+        return requests.get(f"{self.url}/metrics", timeout=self.timeout).text
+
+    def health(self) -> bool:
+        try:
+            return requests.get(f"{self.url}/health", timeout=5).status_code == 200
+        except requests.RequestException:
+            return False

@@ -1,0 +1,5 @@
+from .policy import SchedulerPolicy, ThroughputBasedPolicy
+from .queue import TaskQueue
+from .scheduler import Scheduler
+
+__all__ = ["Scheduler", "SchedulerPolicy", "ThroughputBasedPolicy", "TaskQueue"]

@@ -1,0 +1,96 @@
+"""Elastic parallelism policy.
+
+The reference's ``ThroughputBasedPolicy`` compares each epoch's elapsed time
+against the previous epoch and moves parallelism ±1 worker (reference:
+ml/pkg/scheduler/policy.go:50-94; thresholds at policy.go:9-12 — faster than
+1.05x of the cached time scales up, slower than 1.2x scales down).
+
+TPU twist: worker counts move in *topology-legal* steps — powers of two that
+tile the slice (1, 2, 4, 8, ...) — instead of ±1, because a worker maps to a
+mesh shard and XLA recompiles per mesh shape; halving/doubling keeps layouts
+MXU-friendly and bounds the number of cached executables per job to log2(chips).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Protocol, Tuple
+
+from ..api.types import JobState
+
+# Reference thresholds (ml/pkg/scheduler/policy.go:9-12): an epoch that stayed
+# within 1.05x of the cached time scales up; one 1.2x or slower scales down.
+SPEEDUP_THRESHOLD = 1.05
+SLOWDOWN_THRESHOLD = 1.2
+
+
+def next_power_up(p: int, cap: int) -> int:
+    """Next topology-legal level above p (doubles, capped)."""
+    if p < 1:
+        return 1
+    n = 1
+    while n <= p:
+        n *= 2
+    return min(n, cap)
+
+
+def next_power_down(p: int) -> int:
+    """Next topology-legal level below p (halves, floor 1)."""
+    if p <= 1:
+        return 1
+    n = 1
+    while n * 2 < p:
+        n *= 2
+    return n
+
+
+class SchedulerPolicy(Protocol):
+    """Reference interface (ml/pkg/scheduler/policy.go:18-22)."""
+
+    def calculate_parallelism(self, task) -> Tuple[int, bool]:
+        """Returns (parallelism, is_new_task)."""
+        ...
+
+    def task_finished(self, job_id: str) -> None: ...
+
+
+class ThroughputBasedPolicy:
+    """Per-job epoch-time cache driving topology-legal scale decisions."""
+
+    def __init__(self, default_parallelism: int, max_parallelism: int, limit_parallelism: bool = False):
+        self.default_parallelism = default_parallelism
+        self.max_parallelism = max(1, max_parallelism)
+        # limit_parallelism freezes scale-up (reference: LIMIT_PARALLELISM env,
+        # ml/pkg/train/job.go:210-213 — applied here at the policy instead)
+        self.limit_parallelism = limit_parallelism
+        self._time_cache: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def calculate_parallelism(self, task) -> Tuple[int, bool]:
+        job_id = task.job_id
+        state: JobState = task.state
+        with self._lock:
+            cached = self._time_cache.get(job_id)
+            if cached is None or state.elapsed_time < 0:
+                # first sighting: start at the request's default (policy.go:58-64)
+                p = task.parameters.options.default_parallelism or self.default_parallelism
+                p = max(1, min(p, self.max_parallelism))
+                if state.elapsed_time >= 0:
+                    self._time_cache[job_id] = state.elapsed_time
+                else:
+                    self._time_cache[job_id] = float("inf")
+                return p, True
+            p = max(1, state.parallelism)
+            elapsed = state.elapsed_time
+            if elapsed <= cached * SPEEDUP_THRESHOLD and not self.limit_parallelism:
+                new_p = next_power_up(p, self.max_parallelism)
+            elif elapsed >= cached * SLOWDOWN_THRESHOLD:
+                new_p = next_power_down(p)
+            else:
+                new_p = p
+            self._time_cache[job_id] = elapsed
+            return new_p, False
+
+    def task_finished(self, job_id: str) -> None:
+        with self._lock:
+            self._time_cache.pop(job_id, None)

@@ -1,0 +1,39 @@
+"""Mutex-guarded FIFO of scheduler work items.
+
+Mirrors the reference's scheduler queue (reference: ml/pkg/scheduler/queue.go:15-83):
+a plain FIFO holding both brand-new train tasks and epoch-end re-evaluation
+requests from running jobs; the scheduler loop pops one at a time. Unlike the
+reference's 10ms poll loop, popping blocks on a condition variable so the loop
+wakes immediately when work arrives."""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+from ..api.types import TrainTask
+
+
+class TaskQueue:
+    def __init__(self):
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+
+    def push(self, task: TrainTask) -> None:
+        with self._cond:
+            self._q.append(task)
+            self._cond.notify()
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[TrainTask]:
+        """Pop the oldest item, blocking up to ``timeout`` seconds; None if empty."""
+        with self._cond:
+            if not self._q:
+                self._cond.wait(timeout)
+            if not self._q:
+                return None
+            return self._q.popleft()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._q)

@@ -1,0 +1,113 @@
+"""Scheduler — task queue consumer + elastic parallelism decisions.
+
+The reference scheduler pops queued work every 10ms and either creates the task
+on the parameter server or updates a running job's parallelism
+(reference: ml/pkg/scheduler/scheduler.go:48-89, api.go:47-176). Same design
+here, minus the HTTP hops for in-process deployments: new train requests get an
+8-char job id (reference: scheduler/util.go:8-10) and are queued; running jobs
+enqueue epoch-end re-evaluation requests and block until the loop answers
+through the PS (the reference's job ``schedulerCh`` round-trip,
+ml/pkg/train/job.go:196-215).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import uuid
+from typing import Optional
+
+from ..api.config import Config, get_config
+from ..api.types import JobState, TrainRequest, TrainTask
+from .policy import SchedulerPolicy, ThroughputBasedPolicy
+from .queue import TaskQueue
+
+log = logging.getLogger("kubeml.scheduler")
+
+
+def create_job_id() -> str:
+    """8-char job id (reference: ml/pkg/scheduler/util.go:8-10)."""
+    return uuid.uuid4().hex[:8]
+
+
+class Scheduler:
+    def __init__(
+        self,
+        ps,
+        policy: Optional[SchedulerPolicy] = None,
+        config: Optional[Config] = None,
+        max_parallelism: Optional[int] = None,
+    ):
+        self.cfg = config or get_config()
+        self.ps = ps
+        if max_parallelism is None:
+            max_parallelism = self.cfg.max_parallelism
+        if max_parallelism is None:
+            import jax
+
+            max_parallelism = max(1, len(jax.devices()))
+        self.policy = policy or ThroughputBasedPolicy(
+            default_parallelism=4,
+            max_parallelism=max_parallelism,
+            limit_parallelism=self.cfg.limit_parallelism,
+        )
+        self.queue = TaskQueue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # --- public API (reference routes scheduler/api.go:184-192) ---
+
+    def submit_train(self, request: TrainRequest) -> str:
+        """`/train`: validate, mint job id, enqueue (api.go:78-116)."""
+        request.validate()
+        job_id = create_job_id()
+        task = TrainTask(job_id=job_id, parameters=request, state=JobState())
+        self.queue.push(task)
+        log.info("queued train task %s (%s on %s)", job_id, request.function_name, request.dataset)
+        return job_id
+
+    def update_job(self, task: TrainTask) -> None:
+        """`/job`: a running job asks for next-epoch parallelism (api.go:47-75)."""
+        self.queue.push(task)
+
+    def finish_job(self, job_id: str) -> None:
+        """`/finish/{taskId}`: evict the policy cache (api.go:165-176)."""
+        self.policy.task_finished(job_id)
+
+    def infer(self, model_id: str, data):
+        """`/infer`: bypasses the queue straight to the serving path (api.go:119-162)."""
+        return self.ps.infer(model_id, data)
+
+    # --- loop ---
+
+    def start(self) -> "Scheduler":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, name="scheduler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            task = self.queue.pop(timeout=0.1)
+            if task is None:
+                continue
+            try:
+                self._schedule(task)
+            except Exception:
+                log.exception("scheduling task %s failed", task.job_id)
+
+    def _schedule(self, task: TrainTask) -> None:
+        parallelism, is_new = self.policy.calculate_parallelism(task)
+        task.state.parallelism = parallelism
+        if is_new:
+            log.info("starting job %s with parallelism %d", task.job_id, parallelism)
+            self.ps.start_task(task)
+        else:
+            log.debug("job %s parallelism -> %d", task.job_id, parallelism)
+            self.ps.update_task(task.job_id, parallelism)

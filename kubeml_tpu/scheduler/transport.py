@@ -1,0 +1,100 @@
+"""HTTP facade + client for the scheduler.
+
+Route contract mirrors the reference scheduler API
+(reference: ml/pkg/scheduler/api.go:184-192): ``/train`` ``/infer`` ``/job``
+``/finish/{taskId}`` ``/health``. The client implements the same method surface
+as :class:`Scheduler` so the PS can talk to an in-process scheduler or a remote
+one interchangeably (reference: ml/pkg/scheduler/client/client.go).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import requests
+
+from ..api.config import Config, get_config
+from ..api.errors import error_from_envelope
+from ..api.types import InferRequest, TrainRequest, TrainTask
+from ..utils.httpd import Request, Router, Service
+from .scheduler import Scheduler
+
+
+class SchedulerAPI:
+    def __init__(self, scheduler: Scheduler, config: Optional[Config] = None):
+        self.cfg = config or get_config()
+        self.scheduler = scheduler
+        router = Router("scheduler")
+        router.route("POST", "/train", self._train)
+        router.route("POST", "/infer", self._infer)
+        router.route("POST", "/job", self._job)
+        router.route("DELETE", "/finish/{taskId}", self._finish)
+        self.service = Service(router, self.cfg.host, self.cfg.scheduler_port)
+
+    def _train(self, req: Request):
+        train_req = TrainRequest.from_dict(req.json() or {})
+        return {"id": self.scheduler.submit_train(train_req)}
+
+    def _infer(self, req: Request):
+        body = InferRequest.from_dict(req.json() or {})
+        return {"predictions": self.scheduler.infer(body.model_id, body.data)}
+
+    def _job(self, req: Request):
+        self.scheduler.update_job(TrainTask.from_dict(req.json() or {}))
+        return {}
+
+    def _finish(self, req: Request):
+        self.scheduler.finish_job(req.params["taskId"])
+        return {}
+
+    def start(self) -> "SchedulerAPI":
+        self.service.start()
+        return self
+
+    def stop(self) -> None:
+        self.service.stop()
+
+    @property
+    def url(self) -> str:
+        return self.service.url
+
+
+def _check(resp: requests.Response):
+    if resp.status_code >= 400:
+        raise error_from_envelope(resp.content, resp.status_code)
+    return resp.json()
+
+
+class SchedulerClient:
+    """Remote scheduler with the Scheduler method surface the PS/controller use."""
+
+    def __init__(self, url: str, timeout: float = 30.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def submit_train(self, request: TrainRequest) -> str:
+        return _check(
+            requests.post(f"{self.url}/train", json=request.to_dict(), timeout=self.timeout)
+        )["id"]
+
+    def infer(self, model_id: str, data):
+        r = _check(
+            requests.post(
+                f"{self.url}/infer",
+                json=InferRequest(model_id=model_id, data=data).to_dict(),
+                timeout=self.timeout,
+            )
+        )
+        return r["predictions"]
+
+    def update_job(self, task: TrainTask) -> None:
+        _check(requests.post(f"{self.url}/job", json=task.to_dict(), timeout=self.timeout))
+
+    def finish_job(self, job_id: str) -> None:
+        _check(requests.delete(f"{self.url}/finish/{job_id}", timeout=self.timeout))
+
+    def health(self) -> bool:
+        try:
+            return requests.get(f"{self.url}/health", timeout=5).status_code == 200
+        except requests.RequestException:
+            return False

@@ -1,0 +1,75 @@
+"""CLI tests against a live LocalCluster (reference CLI: ml/pkg/kubeml-cli/)."""
+
+import numpy as np
+import pytest
+
+from kubeml_tpu.cli import main
+from test_controlplane import FN_SOURCE, _wait_done
+from conftest import make_blobs
+
+
+@pytest.fixture
+def cluster(tmp_config):
+    from kubeml_tpu.cluster import LocalCluster
+
+    with LocalCluster(config=tmp_config) as c:
+        yield c
+
+
+def _write_dataset(tmp_path):
+    x, y = make_blobs(128, shape=(8, 8, 1))
+    xt, yt = make_blobs(32, shape=(8, 8, 1), seed=1)
+    paths = {}
+    for name, arr in [("xtr", x), ("ytr", y), ("xte", xt), ("yte", yt)]:
+        p = tmp_path / f"{name}.npy"
+        np.save(p, arr)
+        paths[name] = str(p)
+    return paths
+
+
+def test_cli_full_flow(cluster, tmp_path, capsys):
+    url = ["--url", cluster.controller_url]
+    paths = _write_dataset(tmp_path)
+    assert main(url + [
+        "dataset", "create", "-n", "blobs",
+        "--traindata", paths["xtr"], "--trainlabels", paths["ytr"],
+        "--testdata", paths["xte"], "--testlabels", paths["yte"],
+    ]) == 0
+
+    fn_file = tmp_path / "tiny.py"
+    fn_file.write_text(FN_SOURCE)
+    assert main(url + ["function", "create", "-n", "tiny", "--code", str(fn_file)]) == 0
+
+    assert main(url + ["dataset", "list"]) == 0
+    out = capsys.readouterr().out
+    assert "blobs" in out
+
+    assert main(url + [
+        "train", "-f", "tiny", "-d", "blobs", "-e", "1", "-b", "16",
+        "--lr", "0.05", "-p", "2", "--static", "-K", "2",
+    ]) == 0
+    job_id = capsys.readouterr().out.strip().splitlines()[-1]
+    assert len(job_id) == 8
+
+    from kubeml_tpu.controller.client import KubemlClient
+
+    _wait_done(KubemlClient(cluster.controller_url), job_id)
+
+    assert main(url + ["history", "get", "--id", job_id]) == 0
+    out = capsys.readouterr().out
+    assert "train_loss" in out
+
+    # infer on a finished job 404s cleanly (model no longer resident)
+    datafile = tmp_path / "infer.npy"
+    np.save(datafile, make_blobs(4, shape=(8, 8, 1))[0])
+    assert main(url + ["infer", "-n", job_id, "--datafile", str(datafile)]) == 1
+
+    assert main(url + ["history", "prune"]) == 0
+    assert main(url + ["task", "list", "--short"]) == 0
+    assert main(url + ["function", "delete", "-n", "tiny"]) == 0
+    assert main(url + ["dataset", "delete", "-n", "blobs"]) == 0
+
+
+def test_cli_batch_validation(cluster):
+    assert main(["--url", cluster.controller_url, "train", "-f", "x", "-d", "y",
+                 "-b", "2048"]) == 1
