@@ -286,6 +286,13 @@ def main(argv=None) -> int:
     except FileNotFoundError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    except Exception as e:
+        import requests
+
+        if isinstance(e, requests.RequestException):
+            print(f"error: cannot reach the controller: {e}", file=sys.stderr)
+            return 1
+        raise
 
 
 if __name__ == "__main__":

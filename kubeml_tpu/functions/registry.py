@@ -119,12 +119,16 @@ class FunctionRegistry:
         mod_name = f"kubeml_fn_{name}_{uuid.uuid4().hex[:8]}"
         spec = importlib.util.spec_from_file_location(mod_name, path)
         module = importlib.util.module_from_spec(spec)
+        # registered only for the duration of exec (self-referencing imports,
+        # dataclass machinery); removed after so repeated loads don't leak a
+        # sys.modules entry per job — the model instance keeps the module alive
         sys.modules[mod_name] = module
         try:
             spec.loader.exec_module(module)
         except Exception as e:
-            sys.modules.pop(mod_name, None)
             raise KubeMLError(f"function {name!r} failed to import: {e}", 400) from e
+        finally:
+            sys.modules.pop(mod_name, None)
 
         main = getattr(module, "main", None)
         if callable(main):

@@ -1,1 +1,29 @@
-from .lenet import LeNet  # noqa: F401
+"""Model zoo: the reference demo families (LeNet, ResNet, VGG — reference
+ml/experiments/kubeml/) plus the BASELINE extension targets (ViT, BERT).
+
+Lazily resolved (PEP 562) so importing one family never executes the others —
+``flagship()``'s fallback chain and control-plane-only processes depend on
+submodule imports staying independent."""
+
+_ZOO = {
+    "LeNet": "lenet",
+    "ResNet": "resnet", "ResNet18": "resnet", "ResNet34": "resnet", "ResNet50": "resnet",
+    "VGG": "vgg", "VGG11": "vgg",
+    "ViT": "vit", "ViTTiny": "vit",
+    "BertBase": "bert", "BertClassifier": "bert", "BertTiny": "bert",
+}
+
+__all__ = sorted(_ZOO)
+
+
+def __getattr__(name):
+    if name in _ZOO:
+        import importlib
+
+        mod = importlib.import_module(f".{_ZOO[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return __all__

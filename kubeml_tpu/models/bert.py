@@ -1,0 +1,89 @@
+"""BERT encoder for sequence classification (BASELINE target #4: BERT-base
+SST-2 fine-tune over text shards).
+
+No counterpart in the reference (CNNs only). Input is a ``[B, L]`` int32 token
+id array; id 0 is the padding token and drives the attention mask, so the model
+fits the platform's single-input contract (KubeModel.forward gets one x).
+Built on the shared attention op for the same swap-in reasons as ViT.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+
+PAD_ID = 0
+
+
+class BertSelfAttention(nn.Module):
+    num_heads: int
+
+    @nn.compact
+    def __call__(self, x, mask):
+        B, L, E = x.shape
+        H = self.num_heads
+        D = E // H
+        q = nn.DenseGeneral((H, D), axis=-1, name="query")(x)
+        k = nn.DenseGeneral((H, D), axis=-1, name="key")(x)
+        v = nn.DenseGeneral((H, D), axis=-1, name="value")(x)
+        out = dot_product_attention(q, k, v, mask=mask)
+        return nn.DenseGeneral(E, axis=(-2, -1), name="output")(out)
+
+
+class BertLayer(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dropout: float = 0.1
+
+    @nn.compact
+    def __call__(self, x, mask, train: bool = False):
+        y = BertSelfAttention(self.num_heads)(x, mask)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        x = nn.LayerNorm()(x + y)
+        y = nn.Dense(self.mlp_dim)(x)
+        y = nn.gelu(y)
+        y = nn.Dense(x.shape[-1])(y)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        return nn.LayerNorm()(x + y)
+
+
+class BertClassifier(nn.Module):
+    num_classes: int = 2
+    vocab_size: int = 30522
+    max_len: int = 512
+    embed_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout: float = 0.1
+
+    @nn.compact
+    def __call__(self, token_ids, train: bool = False):
+        token_ids = token_ids.astype(jnp.int32)
+        B, L = token_ids.shape
+        valid = token_ids != PAD_ID  # [B, L]
+        attn_mask = valid[:, None, None, :]  # [B, 1, 1, Lk] -> broadcast over H, Lq
+        x = nn.Embed(self.vocab_size, self.embed_dim, name="token_embed")(token_ids)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, self.max_len, self.embed_dim), x.dtype)
+        x = x + pos[:, :L]
+        x = nn.LayerNorm()(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        for _ in range(self.depth):
+            x = BertLayer(self.num_heads, self.mlp_dim, self.dropout)(x, attn_mask, train=train)
+        # BERT pooler: tanh-projected [CLS]
+        pooled = nn.tanh(nn.Dense(self.embed_dim, name="pooler")(x[:, 0]))
+        pooled = nn.Dropout(self.dropout, deterministic=not train)(pooled)
+        return nn.Dense(self.num_classes)(pooled)
+
+
+def BertBase(num_classes: int = 2, vocab_size: int = 30522) -> BertClassifier:
+    return BertClassifier(num_classes=num_classes, vocab_size=vocab_size)
+
+
+def BertTiny(num_classes: int = 2, vocab_size: int = 1000, max_len: int = 128) -> BertClassifier:
+    """Test/CI-sized config (2 layers, 128 wide)."""
+    return BertClassifier(num_classes=num_classes, vocab_size=vocab_size, max_len=max_len,
+                          embed_dim=128, depth=2, num_heads=2, mlp_dim=256)

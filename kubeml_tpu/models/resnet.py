@@ -1,0 +1,103 @@
+"""ResNet family — the reference's headline models.
+
+The reference trains torchvision-style ResNet-34 on CIFAR-10 as its main
+benchmark (reference: ml/experiments/kubeml/function_resnet34.py, resnet32.py;
+BASELINE.md target #2 uses ResNet-18/34). Flax re-implementation, NHWC layout
+(XLA tiles NHWC convs straight onto the MXU), BatchNorm with batch_stats as a
+mutable collection the K-AVG engine averages at sync (reference averages BN
+counters too: ml/pkg/model/parallelSGD.go:26-54, utils.go:89-136).
+
+``cifar_stem=True`` (default) uses the 3x3/stride-1 stem standard for 32x32
+inputs; set False for the ImageNet 7x7/stride-2 + maxpool stem.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Type
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9)
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                    padding="SAME", use_bias=False)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), strides=(self.strides, self.strides),
+                               use_bias=False)(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: int = 1
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9)
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        y = nn.relu(norm()(y))
+        y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                    padding="SAME", use_bias=False)(y)
+        y = nn.relu(norm()(y))
+        y = nn.Conv(self.filters * self.expansion, (1, 1), use_bias=False)(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters * self.expansion, (1, 1),
+                               strides=(self.strides, self.strides), use_bias=False)(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block: Type[nn.Module] = BasicBlock
+    num_classes: int = 10
+    num_filters: int = 64
+    cifar_stem: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9)
+        if self.cifar_stem:
+            x = nn.Conv(self.num_filters, (3, 3), padding="SAME", use_bias=False)(x)
+            x = nn.relu(norm()(x))
+        else:
+            x = nn.Conv(self.num_filters, (7, 7), strides=(2, 2), padding="SAME",
+                        use_bias=False)(x)
+            x = nn.relu(norm()(x))
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            filters = self.num_filters * 2**i
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block(filters, strides=strides)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def ResNet18(num_classes: int = 10, cifar_stem: bool = True) -> ResNet:
+    return ResNet([2, 2, 2, 2], BasicBlock, num_classes=num_classes, cifar_stem=cifar_stem)
+
+
+def ResNet34(num_classes: int = 10, cifar_stem: bool = True) -> ResNet:
+    return ResNet([3, 4, 6, 3], BasicBlock, num_classes=num_classes, cifar_stem=cifar_stem)
+
+
+def ResNet50(num_classes: int = 10, cifar_stem: bool = True) -> ResNet:
+    return ResNet([3, 4, 6, 3], Bottleneck, num_classes=num_classes, cifar_stem=cifar_stem)

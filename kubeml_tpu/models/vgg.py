@@ -1,0 +1,44 @@
+"""VGG-11 — the reference's CIFAR-100 demo model
+(reference: ml/experiments/kubeml/function_vgg11.py trains torchvision vgg11 on
+CIFAR-100; BASELINE sweep `app/time_to_accuracy.py:53-59`). Flax NHWC
+re-implementation with optional BatchNorm (vgg11_bn equivalent) and a compact
+classifier head sized for 32x32 inputs."""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# channel plan per vgg11: conv layers with 'M' = 2x2 maxpool
+VGG11_PLAN: Sequence[Union[int, str]] = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M")
+
+
+class VGG(nn.Module):
+    plan: Sequence[Union[int, str]] = VGG11_PLAN
+    num_classes: int = 100
+    batch_norm: bool = True
+    classifier_width: int = 512
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for step in self.plan:
+            if step == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(int(step), (3, 3), padding="SAME", use_bias=not self.batch_norm)(x)
+                if self.batch_norm:
+                    x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.classifier_width)(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(self.classifier_width)(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def VGG11(num_classes: int = 100, batch_norm: bool = True) -> VGG:
+    return VGG(VGG11_PLAN, num_classes=num_classes, batch_norm=batch_norm)

@@ -1,0 +1,78 @@
+"""ViT — Vision Transformer (BASELINE target #3: ViT-Tiny on CIFAR-100).
+
+No counterpart in the reference (CNNs only); built on the shared attention op
+(kubeml_tpu.ops.attention) so the platform can swap in Pallas/ring attention.
+ViT-Tiny defaults: embed 192, depth 12, 3 heads; patch 4 suits 32x32 inputs.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+
+
+class MHSA(nn.Module):
+    num_heads: int
+
+    @nn.compact
+    def __call__(self, x):
+        B, L, E = x.shape
+        H = self.num_heads
+        D = E // H
+        qkv = nn.DenseGeneral((3, H, D), axis=-1, name="qkv")(x)  # [B, L, 3, H, D]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = dot_product_attention(q, k, v)
+        return nn.DenseGeneral(E, axis=(-2, -1), name="proj")(out)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = nn.LayerNorm()(x)
+        y = MHSA(self.num_heads)(y)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        x = x + y
+        y = nn.LayerNorm()(x)
+        y = nn.Dense(x.shape[-1] * self.mlp_ratio)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(x.shape[-1])(y)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    num_classes: int = 100
+    patch_size: int = 4
+    embed_dim: int = 192
+    depth: int = 12
+    num_heads: int = 3
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B = x.shape[0]
+        p = self.patch_size
+        # patchify via conv: [B, H/p, W/p, E]
+        x = nn.Conv(self.embed_dim, (p, p), strides=(p, p), padding="VALID",
+                    name="patch_embed")(x)
+        x = x.reshape((B, -1, self.embed_dim))
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.embed_dim), x.dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, self.embed_dim)), x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.embed_dim), x.dtype)
+        x = x + pos
+        for _ in range(self.depth):
+            x = EncoderBlock(self.num_heads, dropout=self.dropout)(x, train=train)
+        x = nn.LayerNorm()(x)
+        return nn.Dense(self.num_classes)(x[:, 0])
+
+
+def ViTTiny(num_classes: int = 100, patch_size: int = 4) -> ViT:
+    return ViT(num_classes=num_classes, patch_size=patch_size,
+               embed_dim=192, depth=12, num_heads=3)

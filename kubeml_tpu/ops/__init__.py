@@ -1,0 +1,3 @@
+from .attention import dot_product_attention, multi_head_attention
+
+__all__ = ["dot_product_attention", "multi_head_attention"]

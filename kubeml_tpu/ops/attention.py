@@ -1,0 +1,62 @@
+"""Attention ops.
+
+One functional attention core shared by every transformer model in the zoo, so
+the engine can swap implementations (XLA einsum here; Pallas flash-attention
+kernel or ring-attention over a sequence mesh axis in kubeml_tpu.parallel)
+without touching model code. The reference has no attention anywhere (CNNs
+only — SURVEY §5 long-context: absent); this is TPU-native greenfield.
+
+Layout notes: heads stay a separate axis ([B, L, H, D]) until the output
+projection so XLA sees clean batched matmuls for the MXU; softmax is computed
+in f32 even under bf16 activations (numerics), matching standard TPU practice.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def dot_product_attention(
+    q: jnp.ndarray,  # [B, Lq, H, D]
+    k: jnp.ndarray,  # [B, Lk, H, D]
+    v: jnp.ndarray,  # [B, Lk, H, D]
+    mask: Optional[jnp.ndarray] = None,  # broadcastable to [B, H, Lq, Lk]; True = attend
+) -> jnp.ndarray:
+    """Standard scaled dot-product attention; returns [B, Lq, H, D]."""
+    depth = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(depth).astype(q.dtype)
+    scores = scores.astype(jnp.float32)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    weights = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    if mask is not None:
+        weights = jnp.where(mask, weights, 0.0)
+    weights = weights / jnp.maximum(weights.sum(axis=-1, keepdims=True), 1e-9)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
+
+
+def multi_head_attention(
+    x: jnp.ndarray,  # [B, L, E]
+    wq: jnp.ndarray,  # [E, H, D]
+    wk: jnp.ndarray,
+    wv: jnp.ndarray,
+    wo: jnp.ndarray,  # [H, D, E]
+    bq: Optional[jnp.ndarray] = None,  # [H, D]
+    bk: Optional[jnp.ndarray] = None,
+    bv: Optional[jnp.ndarray] = None,
+    bo: Optional[jnp.ndarray] = None,  # [E]
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Full MHA from explicit projection weights; returns [B, L, E]."""
+    q = jnp.einsum("ble,ehd->blhd", x, wq)
+    k = jnp.einsum("ble,ehd->blhd", x, wk)
+    v = jnp.einsum("ble,ehd->blhd", x, wv)
+    if bq is not None:
+        q, k, v = q + bq, k + bk, v + bv
+    out = dot_product_attention(q, k, v, mask=mask)
+    y = jnp.einsum("blhd,hde->ble", out, wo)
+    if bo is not None:
+        y = y + bo
+    return y

@@ -54,8 +54,8 @@ class _UpdateBox:
 @dataclass
 class _JobRecord:
     task: TrainTask
-    job: TrainJob
-    thread: threading.Thread
+    job: Optional[TrainJob]  # None only while the start is being prepared
+    thread: Optional[threading.Thread]
     update_box: Optional[_UpdateBox] = None
 
 
@@ -85,32 +85,51 @@ class ParameterServer:
     # --- task lifecycle (reference routes ps/api.go:335-345) ---
 
     def start_task(self, task: TrainTask) -> None:
-        """`/start`: spin up the job (reference api.go:139-222)."""
+        """`/start`: spin up the job (reference api.go:139-222).
+
+        The index slot is reserved atomically before the (slow) model load so
+        two concurrent starts of the same job id can't both win; a failed start
+        leaves a FAILED history record so clients polling the job don't see it
+        silently vanish."""
         req = task.parameters
+        placeholder = _JobRecord(task=task, job=None, thread=None)
         with self._lock:
             if task.job_id in self._jobs:
                 raise KubeMLError(f"job {task.job_id} already exists", 400)
-        model = self.registry.load(req.function_name)
-        model._set_params(
-            lr=req.lr, batch_size=req.batch_size, epoch=0, k=req.options.k, task="train"
-        )
-        req.options.default_parallelism = task.state.parallelism or req.options.default_parallelism
-        job = TrainJob(
-            task.job_id,
-            req,
-            model,
-            store=self.store,
-            history_store=self.history_store,
-            on_epoch_end=lambda state, jid=task.job_id: self._epoch_end(jid, state),
-            on_metrics=self.metrics.update,
-            devices=self.devices,
-        )
+            self._jobs[task.job_id] = placeholder
+        try:
+            model = self.registry.load(req.function_name)
+            model._set_params(
+                lr=req.lr, batch_size=req.batch_size, epoch=0, k=req.options.k, task="train"
+            )
+            req.options.default_parallelism = (
+                task.state.parallelism or req.options.default_parallelism
+            )
+            job = TrainJob(
+                task.job_id,
+                req,
+                model,
+                store=self.store,
+                history_store=self.history_store,
+                on_epoch_end=lambda state, jid=task.job_id: self._epoch_end(jid, state),
+                on_metrics=self.metrics.update,
+                devices=self.devices,
+            )
+        except Exception as e:
+            task.status = JobStateEnum.FAILED
+            with self._lock:
+                self._jobs.pop(task.job_id, None)
+            from ..api.types import History
+
+            self.history_store.save(
+                History(id=task.job_id, task={"request": req.to_dict(), "error": str(e)})
+            )
+            raise
         thread = threading.Thread(
             target=self._run_job, args=(task, job), name=f"job-{task.job_id}", daemon=True
         )
-        record = _JobRecord(task=task, job=job, thread=thread)
-        with self._lock:
-            self._jobs[task.job_id] = record
+        placeholder.job = job
+        placeholder.thread = thread
         task.status = JobStateEnum.RUNNING
         self.metrics.task_started("train")
         thread.start()
@@ -197,6 +216,8 @@ class ParameterServer:
             record = self._jobs.get(job_id)
         if record is None:
             raise JobNotFoundError(job_id)
+        if record.job is None:
+            raise KubeMLError(f"job {job_id} is still starting", 409)
         record.job.stop()
 
     def wait(self, job_id: str, timeout: Optional[float] = None) -> bool:
@@ -205,6 +226,8 @@ class ParameterServer:
             record = self._jobs.get(job_id)
         if record is None:
             return True
+        if record.thread is None:
+            return False  # still starting
         record.thread.join(timeout)
         return not record.thread.is_alive()
 
@@ -214,6 +237,8 @@ class ParameterServer:
             record = self._jobs.get(model_id)
         if record is None:
             raise JobNotFoundError(model_id)
+        if record.job is None:
+            raise KubeMLError(f"job {model_id} is still starting", 503)
         self.metrics.task_started("inference")
         try:
             return np.asarray(record.job.infer(np.asarray(data))).tolist()

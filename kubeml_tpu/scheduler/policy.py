@@ -59,7 +59,9 @@ class ThroughputBasedPolicy:
 
     def __init__(self, default_parallelism: int, max_parallelism: int, limit_parallelism: bool = False):
         self.default_parallelism = default_parallelism
-        self.max_parallelism = max(1, max_parallelism)
+        # floor the cap to a power of two so scale-up never lands on a
+        # topology-illegal level (e.g. cap 6 -> levels 1,2,4)
+        self.max_parallelism = next_power_down(max(1, max_parallelism) + 1)
         # limit_parallelism freezes scale-up (reference: LIMIT_PARALLELISM env,
         # ml/pkg/train/job.go:210-213 — applied here at the policy instead)
         self.limit_parallelism = limit_parallelism
@@ -67,19 +69,22 @@ class ThroughputBasedPolicy:
         self._lock = threading.Lock()
 
     def calculate_parallelism(self, task) -> Tuple[int, bool]:
+        """is_new is decided by the task itself (a fresh submission has no
+        elapsed time yet), NOT by cache state — a stale epoch-end update for a
+        finished job whose cache was evicted must never restart the job."""
         job_id = task.job_id
         state: JobState = task.state
         with self._lock:
-            cached = self._time_cache.get(job_id)
-            if cached is None or state.elapsed_time < 0:
-                # first sighting: start at the request's default (policy.go:58-64)
+            if state.elapsed_time < 0:
+                # fresh submission: start at the request's default (policy.go:58-64)
                 p = task.parameters.options.default_parallelism or self.default_parallelism
                 p = max(1, min(p, self.max_parallelism))
-                if state.elapsed_time >= 0:
-                    self._time_cache[job_id] = state.elapsed_time
-                else:
-                    self._time_cache[job_id] = float("inf")
+                self._time_cache[job_id] = float("inf")
                 return p, True
+            cached = self._time_cache.get(job_id)
+            if cached is None:
+                # stale update (job already finished, cache evicted): keep as-is
+                return max(1, state.parallelism), False
             p = max(1, state.parallelism)
             elapsed = state.elapsed_time
             if elapsed <= cached * SPEEDUP_THRESHOLD and not self.limit_parallelism:

@@ -45,7 +45,10 @@ class Scheduler:
         if max_parallelism is None:
             import jax
 
-            max_parallelism = max(1, len(jax.devices()))
+            # floor of 8: on a small/single-chip host workers pack onto chips
+            # (vmap inside the SPMD program), so elasticity must not collapse
+            # to 1 just because one chip is visible
+            max_parallelism = max(8, len(jax.devices()))
         self.policy = policy or ThroughputBasedPolicy(
             default_parallelism=4,
             max_parallelism=max_parallelism,
