@@ -1,0 +1,141 @@
+"""Causal transformer LM — the long-context / multi-axis-parallel flagship.
+
+No counterpart in the reference (CNNs only; SURVEY §5 long-context: absent).
+Every weight is annotated with ``nn.with_partitioning`` mesh-axis names so
+``nn.get_partition_spec`` yields the tensor-parallel sharding directly
+(megatron-style: qkv/mlp-in column-sharded over ``tp``, proj/mlp-out
+row-sharded; XLA inserts the psum on the row-sharded matmuls). Attention runs
+as ring attention over the ``sp`` axis when a mesh with sp > 1 is attached
+(jax.shard_map inside jit), else as plain full attention.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import dot_product_attention
+from ..parallel.ring import ring_attention
+
+PAD_ID = 0
+
+
+def _part(names):
+    return lambda init: nn.with_partitioning(init, names)
+
+
+class CausalSelfAttention(nn.Module):
+    num_heads: int
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, valid):
+        B, L, E = x.shape
+        H = self.num_heads
+        D = E // H
+        # 2-D kernels with manual head reshape: column-sharding [E, H*D] over
+        # tp IS head-sharding (heads are the leading factor of the columns)
+        dense = lambda feats, names, name: nn.Dense(
+            feats, name=name,
+            kernel_init=_part(names)(nn.initializers.lecun_normal()),
+            use_bias=False,
+        )
+        heads = lambda t: t.reshape(B, L, H, D)
+        q = heads(dense(H * D, (None, "tp"), "query")(x))
+        k = heads(dense(H * D, (None, "tp"), "key")(x))
+        v = heads(dense(H * D, (None, "tp"), "value")(x))
+        out_proj = dense(E, ("tp", None), "proj")
+
+        if self.mesh is not None and self.mesh.shape.get("sp", 1) > 1:
+            attn = jax.shard_map(
+                lambda q, k, v, val: ring_attention(
+                    q, k, v, axis_name="sp", causal=True, kv_valid=val
+                ),
+                mesh=self.mesh,
+                in_specs=(
+                    P("dp", "sp", "tp", None),
+                    P("dp", "sp", "tp", None),
+                    P("dp", "sp", "tp", None),
+                    P("dp", "sp"),
+                ),
+                out_specs=P("dp", "sp", "tp", None),
+                check_vma=False,
+            )
+            out = attn(q, k, v, valid)
+        else:
+            causal = (jnp.arange(L)[None, :] <= jnp.arange(L)[:, None])[None, None]
+            mask = causal & valid[:, None, None, :]
+            out = dot_product_attention(q, k, v, mask=mask)
+        return out_proj(out.reshape(B, L, H * D))
+
+
+class GPTBlock(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, valid, train: bool = False):
+        y = nn.LayerNorm(name="ln1")(x)
+        y = CausalSelfAttention(self.num_heads, mesh=self.mesh, name="attn")(y, valid)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        x = x + y
+        y = nn.LayerNorm(name="ln2")(x)
+        E = x.shape[-1]
+        y = nn.Dense(E * self.mlp_ratio, name="mlp_in",
+                     kernel_init=_part((None, "tp"))(nn.initializers.lecun_normal()),
+                     bias_init=_part(("tp",))(nn.initializers.zeros))(y)
+        y = nn.gelu(y)
+        y = nn.Dense(E, name="mlp_out",
+                     kernel_init=_part(("tp", None))(nn.initializers.lecun_normal()))(y)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        return x + y
+
+
+class CausalTransformer(nn.Module):
+    """Decoder-only LM over int32 token ids [B, L]; id 0 = padding."""
+
+    vocab_size: int = 32000
+    max_len: int = 2048
+    embed_dim: int = 512
+    depth: int = 8
+    num_heads: int = 8
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, token_ids, train: bool = False):
+        token_ids = token_ids.astype(jnp.int32)
+        B, L = token_ids.shape
+        valid = token_ids != PAD_ID
+        x = nn.Embed(self.vocab_size, self.embed_dim, name="token_embed",
+                     embedding_init=_part((None, "tp"))(nn.initializers.normal(0.02)))(token_ids)
+        pos = self.param("pos_embed",
+                         _part((None, None, "tp"))(nn.initializers.normal(0.02)),
+                         (1, self.max_len, self.embed_dim))
+        x = x + pos[:, :L]
+        for i in range(self.depth):
+            x = GPTBlock(self.num_heads, self.mlp_ratio, self.dropout,
+                         mesh=self.mesh, name=f"block_{i}")(x, valid, train=train)
+        x = nn.LayerNorm(name="ln_f")(x)
+        logits = nn.Dense(self.vocab_size, name="lm_head", use_bias=False,
+                          kernel_init=_part((None, "tp"))(nn.initializers.lecun_normal()))(x)
+        return logits
+
+
+def GPTTiny(vocab_size: int = 1000, max_len: int = 128, mesh=None) -> CausalTransformer:
+    """Test-sized config."""
+    return CausalTransformer(vocab_size=vocab_size, max_len=max_len, embed_dim=64,
+                             depth=2, num_heads=4, mesh=mesh)
+
+
+def GPTSmall(vocab_size: int = 32000, max_len: int = 2048, mesh=None) -> CausalTransformer:
+    """GPT-2-small-ish (124M)."""
+    return CausalTransformer(vocab_size=vocab_size, max_len=max_len, embed_dim=768,
+                             depth=12, num_heads=12, mesh=mesh)

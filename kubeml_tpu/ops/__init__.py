@@ -1,3 +1,3 @@
-from .attention import dot_product_attention, multi_head_attention
+from .attention import dot_product_attention
 
-__all__ = ["dot_product_attention", "multi_head_attention"]
+__all__ = ["dot_product_attention"]

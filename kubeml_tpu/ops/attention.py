@@ -35,28 +35,3 @@ def dot_product_attention(
         weights = jnp.where(mask, weights, 0.0)
     weights = weights / jnp.maximum(weights.sum(axis=-1, keepdims=True), 1e-9)
     return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
-
-
-def multi_head_attention(
-    x: jnp.ndarray,  # [B, L, E]
-    wq: jnp.ndarray,  # [E, H, D]
-    wk: jnp.ndarray,
-    wv: jnp.ndarray,
-    wo: jnp.ndarray,  # [H, D, E]
-    bq: Optional[jnp.ndarray] = None,  # [H, D]
-    bk: Optional[jnp.ndarray] = None,
-    bv: Optional[jnp.ndarray] = None,
-    bo: Optional[jnp.ndarray] = None,  # [E]
-    mask: Optional[jnp.ndarray] = None,
-) -> jnp.ndarray:
-    """Full MHA from explicit projection weights; returns [B, L, E]."""
-    q = jnp.einsum("ble,ehd->blhd", x, wq)
-    k = jnp.einsum("ble,ehd->blhd", x, wk)
-    v = jnp.einsum("ble,ehd->blhd", x, wv)
-    if bq is not None:
-        q, k, v = q + bq, k + bk, v + bv
-    out = dot_product_attention(q, k, v, mask=mask)
-    y = jnp.einsum("blhd,hde->ble", out, wo)
-    if bo is not None:
-        y = y + bo
-    return y

@@ -1,0 +1,21 @@
+"""Multi-dimensional parallelism for TPU meshes.
+
+The reference's only parallelism is elastic data-parallel K-AVG over serverless
+functions (SURVEY §2.4). On TPU the same framework owns a device mesh, so this
+package adds the TPU-idiomatic axes as first-class extensions:
+
+* ``dp``  — data parallel (batch sharded; gradient psum over ICI)
+* ``tp``  — tensor parallel (megatron-style sharded matmuls inside blocks)
+* ``sp``  — sequence/context parallel (ring attention over ``ppermute``)
+* ``ep``  — expert parallel (MoE experts sharded; all_to_all dispatch)
+* ``pp``  — pipeline parallel (stage-sharded, microbatched)
+
+Design recipe (scaling-book style): pick a mesh, annotate shardings, let XLA
+insert collectives; hand-written collectives (shard_map + ppermute) only where
+the schedule matters (ring attention, a2a expert dispatch).
+"""
+
+from .mesh import make_mesh, mesh_shape_for
+from .ring import ring_attention
+
+__all__ = ["make_mesh", "mesh_shape_for", "ring_attention"]

@@ -1,0 +1,59 @@
+"""Device mesh construction with named parallelism axes."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order: outermost (slowest-varying, cheapest to cross less
+# often) first. dp outermost, then pp stages, ep, sp, tp innermost — tp wants
+# the fastest links because its collectives are in every matmul.
+AXIS_ORDER = ("dp", "pp", "ep", "sp", "tp")
+
+
+def mesh_shape_for(n_devices: int, **requested: int) -> Dict[str, int]:
+    """Fill in a full axis-shape dict for ``n_devices``: requested axes keep
+    their sizes, remaining devices go to ``dp``."""
+    shape = {ax: 1 for ax in AXIS_ORDER}
+    used = 1
+    for ax, size in requested.items():
+        if ax not in shape:
+            raise ValueError(f"unknown mesh axis {ax!r} (valid: {AXIS_ORDER})")
+        if size < 1:
+            raise ValueError(f"mesh axis {ax!r} must be >= 1")
+        shape[ax] = size
+        used *= size
+    if n_devices % used != 0:
+        raise ValueError(
+            f"requested axes use {used} devices which does not divide {n_devices}"
+        )
+    shape["dp"] *= n_devices // used
+    return shape
+
+
+def make_mesh(
+    shape: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    **axes: int,
+) -> Mesh:
+    """Build a Mesh from an axis-shape dict (or kwargs), e.g.
+    ``make_mesh(dp=2, tp=2, sp=2)`` on 8 devices.
+
+    Axes of size 1 are kept in the mesh so PartitionSpecs can always name
+    them — a spec over a size-1 axis is a no-op, which lets one set of
+    sharding rules serve every mesh shape."""
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = mesh_shape_for(len(devices), **axes)
+    total = int(np.prod(list(shape.values())))
+    if total > len(devices):
+        raise ValueError(f"mesh needs {total} devices, only {len(devices)} available")
+    names = tuple(ax for ax in AXIS_ORDER if ax in shape)
+    extra = tuple(ax for ax in shape if ax not in AXIS_ORDER)
+    names = names + extra
+    dims = tuple(shape[ax] for ax in names)
+    grid = np.array(devices[:total]).reshape(dims)
+    return Mesh(grid, names)

@@ -83,7 +83,11 @@ class ThroughputBasedPolicy:
                 return p, True
             cached = self._time_cache.get(job_id)
             if cached is None:
-                # stale update (job already finished, cache evicted): keep as-is
+                # unseen live job (e.g. policy swapped mid-run): keep the current
+                # parallelism but reseed the cache so elasticity resumes next
+                # epoch. (Stale updates for finished jobs never reach here — the
+                # scheduler drops them.)
+                self._time_cache[job_id] = state.elapsed_time
                 return max(1, state.parallelism), False
             p = max(1, state.parallelism)
             elapsed = state.elapsed_time

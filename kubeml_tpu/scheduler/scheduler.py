@@ -15,6 +15,7 @@ from __future__ import annotations
 import logging
 import threading
 import uuid
+from collections import deque
 from typing import Optional
 
 from ..api.config import Config, get_config
@@ -57,6 +58,9 @@ class Scheduler:
         self.queue = TaskQueue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # recently finished job ids: stale epoch-end updates still in the queue
+        # when a job finishes must be dropped, not rescheduled
+        self._finished: "deque[str]" = deque(maxlen=1024)
 
     # --- public API (reference routes scheduler/api.go:184-192) ---
 
@@ -75,6 +79,7 @@ class Scheduler:
 
     def finish_job(self, job_id: str) -> None:
         """`/finish/{taskId}`: evict the policy cache (api.go:165-176)."""
+        self._finished.append(job_id)
         self.policy.task_finished(job_id)
 
     def infer(self, model_id: str, data):
@@ -106,6 +111,9 @@ class Scheduler:
                 log.exception("scheduling task %s failed", task.job_id)
 
     def _schedule(self, task: TrainTask) -> None:
+        if task.state.elapsed_time >= 0 and task.job_id in self._finished:
+            log.debug("dropping stale update for finished job %s", task.job_id)
+            return
         parallelism, is_new = self.policy.calculate_parallelism(task)
         task.state.parallelism = parallelism
         if is_new:
