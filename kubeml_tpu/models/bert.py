@@ -21,14 +21,14 @@ class BertSelfAttention(nn.Module):
     num_heads: int
 
     @nn.compact
-    def __call__(self, x, mask):
+    def __call__(self, x, valid):
         B, L, E = x.shape
         H = self.num_heads
         D = E // H
         q = nn.DenseGeneral((H, D), axis=-1, name="query")(x)
         k = nn.DenseGeneral((H, D), axis=-1, name="key")(x)
         v = nn.DenseGeneral((H, D), axis=-1, name="value")(x)
-        out = dot_product_attention(q, k, v, mask=mask)
+        out = dot_product_attention(q, k, v, kv_valid=valid)
         return nn.DenseGeneral(E, axis=(-2, -1), name="output")(out)
 
 
@@ -38,8 +38,8 @@ class BertLayer(nn.Module):
     dropout: float = 0.1
 
     @nn.compact
-    def __call__(self, x, mask, train: bool = False):
-        y = BertSelfAttention(self.num_heads)(x, mask)
+    def __call__(self, x, valid, train: bool = False):
+        y = BertSelfAttention(self.num_heads)(x, valid)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = nn.LayerNorm()(x + y)
         y = nn.Dense(self.mlp_dim)(x)
@@ -63,8 +63,7 @@ class BertClassifier(nn.Module):
     def __call__(self, token_ids, train: bool = False):
         token_ids = token_ids.astype(jnp.int32)
         B, L = token_ids.shape
-        valid = token_ids != PAD_ID  # [B, L]
-        attn_mask = valid[:, None, None, :]  # [B, 1, 1, Lk] -> broadcast over H, Lq
+        valid = token_ids != PAD_ID  # [B, L] — drives kv masking in attention
         x = nn.Embed(self.vocab_size, self.embed_dim, name="token_embed")(token_ids)
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (1, self.max_len, self.embed_dim), x.dtype)
@@ -72,7 +71,7 @@ class BertClassifier(nn.Module):
         x = nn.LayerNorm()(x)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
         for _ in range(self.depth):
-            x = BertLayer(self.num_heads, self.mlp_dim, self.dropout)(x, attn_mask, train=train)
+            x = BertLayer(self.num_heads, self.mlp_dim, self.dropout)(x, valid, train=train)
         # BERT pooler: tanh-projected [CLS]
         pooled = nn.tanh(nn.Dense(self.embed_dim, name="pooler")(x[:, 0]))
         pooled = nn.Dropout(self.dropout, deterministic=not train)(pooled)

@@ -67,9 +67,7 @@ class CausalSelfAttention(nn.Module):
             )
             out = attn(q, k, v, valid)
         else:
-            causal = (jnp.arange(L)[None, :] <= jnp.arange(L)[:, None])[None, None]
-            mask = causal & valid[:, None, None, :]
-            out = dot_product_attention(q, k, v, mask=mask)
+            out = dot_product_attention(q, k, v, causal=True, kv_valid=valid)
         return out_proj(out.reshape(B, L, H * D))
 
 

@@ -1,3 +1,4 @@
 from .attention import dot_product_attention
+from .flash_attention import flash_attention
 
-__all__ = ["dot_product_attention"]
+__all__ = ["dot_product_attention", "flash_attention"]

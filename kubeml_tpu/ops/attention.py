@@ -1,10 +1,15 @@
 """Attention ops.
 
 One functional attention core shared by every transformer model in the zoo, so
-the engine can swap implementations (XLA einsum here; Pallas flash-attention
-kernel or ring-attention over a sequence mesh axis in kubeml_tpu.parallel)
-without touching model code. The reference has no attention anywhere (CNNs
-only — SURVEY §5 long-context: absent); this is TPU-native greenfield.
+the engine can swap implementations without touching model code: the XLA
+einsum path here, the Pallas flash-attention kernel
+(kubeml_tpu.ops.flash_attention) on TPU, or ring-attention over a sequence
+mesh axis (kubeml_tpu.parallel.ring). The reference has no attention anywhere
+(CNNs only — SURVEY §5 long-context: absent); this is TPU-native greenfield.
+
+Dispatch: callers that express masking structurally (``causal`` /
+``kv_valid``) get the Pallas kernel on TPU automatically; an arbitrary dense
+``mask`` forces the XLA path (the kernel handles only the structured forms).
 
 Layout notes: heads stay a separate axis ([B, L, H, D]) until the output
 projection so XLA sees clean batched matmuls for the MXU; softmax is computed
@@ -15,6 +20,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 
@@ -23,8 +29,33 @@ def dot_product_attention(
     k: jnp.ndarray,  # [B, Lk, H, D]
     v: jnp.ndarray,  # [B, Lk, H, D]
     mask: Optional[jnp.ndarray] = None,  # broadcastable to [B, H, Lq, Lk]; True = attend
+    *,
+    causal: bool = False,
+    kv_valid: Optional[jnp.ndarray] = None,  # [B, Lk] True = real token
+    impl: Optional[str] = None,  # None=auto | "xla" | "pallas"
 ) -> jnp.ndarray:
-    """Standard scaled dot-product attention; returns [B, Lq, H, D]."""
+    """Scaled dot-product attention; returns [B, Lq, H, D].
+
+    Masking comes either as a dense ``mask`` (XLA path only) or structurally
+    as ``causal`` / ``kv_valid`` (eligible for the Pallas flash kernel).
+    """
+    if impl is None:
+        impl = "pallas" if mask is None and jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        from .flash_attention import flash_attention
+
+        if mask is not None:
+            raise ValueError("pallas impl takes causal/kv_valid, not a dense mask")
+        return flash_attention(q, k, v, causal=causal, kv_valid=kv_valid)
+
+    if causal or kv_valid is not None:
+        lq, lk = q.shape[1], k.shape[1]
+        extra = jnp.ones((1, 1, lq, lk), bool)
+        if causal:
+            extra = extra & (jnp.arange(lk)[None, :] <= jnp.arange(lq)[:, None])[None, None]
+        if kv_valid is not None:
+            extra = extra & kv_valid[:, None, None, :].astype(bool)
+        mask = extra if mask is None else mask & extra
     depth = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(depth).astype(q.dtype)
     scores = scores.astype(jnp.float32)

@@ -1,0 +1,179 @@
+"""Pallas TPU flash-attention kernel.
+
+The hot op of every transformer in the zoo (ViT/BERT/GPT — no counterpart in
+the reference, which is CNN-only; SURVEY §5 long-context: absent). The XLA
+einsum path in kubeml_tpu.ops.attention materializes the full ``[B, H, L, L]``
+score tensor in HBM; this kernel streams K/V blocks through VMEM with the
+online-softmax recurrence so scores never leave the chip, and the two matmuls
+per block land on the MXU as clean ``[block_q, D] x [D, block_k]`` /
+``[block_q, block_k] x [block_k, D]`` contractions.
+
+Grid layout: one program per (batch, head, q-block); K/V for that (batch,
+head) stay VMEM-resident and the kernel walks them in ``block_k`` slices with
+a ``fori_loop`` (causal walks only up to the diagonal). Padding to block
+multiples happens in the wrapper; padded keys are masked via the ``kv_valid``
+lane so odd sequence lengths are exact.
+
+Backward runs as an XLA recompute of the reference attention (standard
+rematerialized-backward trade: forward saves only q/k/v, not scores). A full
+Pallas backward kernel is a further optimization, not a semantic change.
+
+Set ``interpret=True`` (automatic off-TPU) to run the same kernel on CPU for
+tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30  # large-negative instead of -inf keeps exp() NaN-free for fully
+# masked rows (same trick as kubeml_tpu.parallel.ring)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, *, causal: bool, block_k: int):
+    """One (batch, head, q-block) program: online softmax over K/V blocks."""
+    q = q_ref[0, 0].astype(jnp.float32)  # [BQ, D]
+    bq, d = q.shape
+    lk = k_ref.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    q_start = pl.program_id(2) * bq
+
+    def body(j, carry):
+        acc, m, l = carry
+        # whenever the loop runs >1 iteration, block_k == 128, so the offset is
+        # lane-aligned; the hint lets Mosaic prove it statically
+        off = pl.multiple_of(j * block_k, block_k)
+        k_blk = k_ref[0, 0, pl.ds(off, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(off, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(  # [BQ, BK] — q @ k^T on the MXU
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        valid_blk = valid_ref[0, 0:1, pl.ds(off, block_k)]  # [1, BK]
+        s = jnp.where(valid_blk > 0, s, _NEG)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))  # [BQ, 1]
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(s <= _NEG / 2, 0.0, p)  # fully-masked rows stay exactly 0
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(  # [BQ, D] — p @ v on the MXU
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc * alpha + pv, m_new, l_new
+
+    if causal:
+        # blocks strictly above the diagonal contribute nothing — skip them
+        n_blocks = jnp.minimum((q_start + bq + block_k - 1) // block_k, lk // block_k)
+    else:
+        n_blocks = lk // block_k
+    acc, _, l = jax.lax.fori_loop(
+        0,
+        n_blocks,
+        body,
+        (
+            jnp.zeros((bq, d), jnp.float32),
+            jnp.full((bq, 1), _NEG, jnp.float32),
+            jnp.zeros((bq, 1), jnp.float32),
+        ),
+    )
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-9)).astype(o_ref.dtype)
+
+
+def _flash_fwd_impl(q, k, v, valid, *, causal: bool, block_q: int, block_k: int,
+                    interpret: bool):
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    # Mosaic requires 128-lane tiles on real hardware, so blocks are at least
+    # (128, 128) there (short sequences just pad up); interpret mode keeps
+    # small blocks so tests can exercise the multi-block recurrence cheaply.
+    min_blk = 8 if interpret else 128
+    bq = max(min(block_q, _round_up(lq, 8)), min_blk)
+    bk = max(min(block_k, _round_up(lk, 8)), min_blk)
+    lqp, lkp = _round_up(lq, bq), _round_up(lk, bk)
+
+    # [B, L, H, D] -> [B, H, L, D] padded to block multiples; padded keys are
+    # marked invalid so odd lengths stay exact, padded queries are sliced off.
+    def prep(t, lp):
+        t = jnp.moveaxis(t, 2, 1)
+        return jnp.pad(t, ((0, 0), (0, 0), (0, lp - t.shape[2]), (0, 0)))
+
+    qt, kt, vt = prep(q, lqp), prep(k, lkp), prep(v, lkp)
+    # [B, 1, Lkp]: a unit middle axis keeps the block's trailing dims equal to
+    # the array dims, satisfying the Mosaic (8, 128) tiling rule for any B
+    valid_p = jnp.pad(valid.astype(jnp.float32), ((0, 0), (0, lkp - lk)))[:, None, :]
+
+    out = pl.pallas_call(
+        functools.partial(_fa_kernel, causal=causal, block_k=bk),
+        grid=(b, h, lqp // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda i, j, n: (i, j, n, 0)),
+            pl.BlockSpec((1, 1, lkp, d), lambda i, j, n: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, lkp, d), lambda i, j, n: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, lkp), lambda i, j, n: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda i, j, n: (i, j, n, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, lqp, d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, valid_p)
+    return jnp.moveaxis(out[:, :, :lq], 1, 2)
+
+
+def _xla_reference(q, k, v, valid, causal: bool):
+    """Plain-XLA attention with the same (causal, kv_valid) masking — used for
+    the rematerialized backward and as the numerics oracle in tests. Delegates
+    the mask construction to the dispatch layer so the semantics live once."""
+    from .attention import dot_product_attention
+
+    return dot_product_attention(q, k, v, causal=causal, kv_valid=valid, impl="xla")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _flash(causal, block_q, block_k, interpret, q, k, v, valid):
+    return _flash_fwd_impl(q, k, v, valid, causal=causal, block_q=block_q,
+                           block_k=block_k, interpret=interpret)
+
+
+def _flash_fwd(causal, block_q, block_k, interpret, q, k, v, valid):
+    out = _flash(causal, block_q, block_k, interpret, q, k, v, valid)
+    return out, (q, k, v, valid)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, valid = res
+    _, vjp = jax.vjp(lambda q, k, v: _xla_reference(q, k, v, valid, causal), q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, jnp.zeros_like(valid, dtype=jnp.float32)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,  # [B, Lq, H, D]
+    k: jnp.ndarray,  # [B, Lk, H, D]
+    v: jnp.ndarray,  # [B, Lk, H, D]
+    causal: bool = False,
+    kv_valid: Optional[jnp.ndarray] = None,  # [B, Lk] True/1 = real token
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Flash attention; returns [B, Lq, H, D]. Differentiable (recompute bwd)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if kv_valid is None:
+        kv_valid = jnp.ones(k.shape[:2], jnp.float32)
+    return _flash(causal, block_q, block_k, interpret,
+                  q, k, v, kv_valid.astype(jnp.float32))

@@ -49,8 +49,13 @@ def make_mesh(
     if shape is None:
         shape = mesh_shape_for(len(devices), **axes)
     total = int(np.prod(list(shape.values())))
-    if total > len(devices):
-        raise ValueError(f"mesh needs {total} devices, only {len(devices)} available")
+    if total != len(devices):
+        # an explicit shape must account for every device — silently building
+        # on a prefix would leave hardware idle; pass devices[:n] to use fewer
+        raise ValueError(
+            f"mesh shape {shape} uses {total} devices but {len(devices)} were "
+            f"given; slice the device list explicitly to use a subset"
+        )
     names = tuple(ax for ax in AXIS_ORDER if ax in shape)
     extra = tuple(ax for ax in shape if ax not in AXIS_ORDER)
     names = names + extra

@@ -23,6 +23,9 @@ from ..api.types import JobState
 SPEEDUP_THRESHOLD = 1.05
 SLOWDOWN_THRESHOLD = 1.2
 
+# how many finished job ids to remember for stale-update dropping
+FINISHED_MEMORY = 1024
+
 
 def next_power_up(p: int, cap: int) -> int:
     """Next topology-legal level above p (doubles, capped)."""
@@ -47,8 +50,8 @@ def next_power_down(p: int) -> int:
 class SchedulerPolicy(Protocol):
     """Reference interface (ml/pkg/scheduler/policy.go:18-22)."""
 
-    def calculate_parallelism(self, task) -> Tuple[int, bool]:
-        """Returns (parallelism, is_new_task)."""
+    def calculate_parallelism(self, task) -> Optional[Tuple[int, bool]]:
+        """Returns (parallelism, is_new_task), or None to drop a stale update."""
         ...
 
     def task_finished(self, job_id: str) -> None: ...
@@ -66,27 +69,34 @@ class ThroughputBasedPolicy:
         # ml/pkg/train/job.go:210-213 — applied here at the policy instead)
         self.limit_parallelism = limit_parallelism
         self._time_cache: Dict[str, float] = {}
+        # insertion-ordered bounded set of finished job ids (stale-update guard)
+        self._finished: Dict[str, None] = {}
         self._lock = threading.Lock()
 
-    def calculate_parallelism(self, task) -> Tuple[int, bool]:
-        """is_new is decided by the task itself (a fresh submission has no
-        elapsed time yet), NOT by cache state — a stale epoch-end update for a
-        finished job whose cache was evicted must never restart the job."""
+    def calculate_parallelism(self, task) -> Optional[Tuple[int, bool]]:
+        """Returns (parallelism, is_new), or ``None`` when the update is stale
+        (its job already finished) and must be dropped. is_new is decided by
+        the task itself (a fresh submission has no elapsed time yet), NOT by
+        cache state. Finished-job bookkeeping lives here, under the same lock
+        as the cache, so a concurrent task_finished can never interleave
+        between a staleness check and a cache reseed."""
         job_id = task.job_id
         state: JobState = task.state
         with self._lock:
             if state.elapsed_time < 0:
                 # fresh submission: start at the request's default (policy.go:58-64)
+                self._finished.pop(job_id, None)  # allow job-id reuse
                 p = task.parameters.options.default_parallelism or self.default_parallelism
                 p = max(1, min(p, self.max_parallelism))
                 self._time_cache[job_id] = float("inf")
                 return p, True
+            if job_id in self._finished:
+                return None
             cached = self._time_cache.get(job_id)
             if cached is None:
                 # unseen live job (e.g. policy swapped mid-run): keep the current
                 # parallelism but reseed the cache so elasticity resumes next
-                # epoch. (Stale updates for finished jobs never reach here — the
-                # scheduler drops them.)
+                # epoch.
                 self._time_cache[job_id] = state.elapsed_time
                 return max(1, state.parallelism), False
             p = max(1, state.parallelism)
@@ -103,3 +113,6 @@ class ThroughputBasedPolicy:
     def task_finished(self, job_id: str) -> None:
         with self._lock:
             self._time_cache.pop(job_id, None)
+            self._finished[job_id] = None
+            while len(self._finished) > FINISHED_MEMORY:
+                self._finished.pop(next(iter(self._finished)))

@@ -15,7 +15,6 @@ from __future__ import annotations
 import logging
 import threading
 import uuid
-from collections import deque
 from typing import Optional
 
 from ..api.config import Config, get_config
@@ -58,9 +57,6 @@ class Scheduler:
         self.queue = TaskQueue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # recently finished job ids: stale epoch-end updates still in the queue
-        # when a job finishes must be dropped, not rescheduled
-        self._finished: "deque[str]" = deque(maxlen=1024)
 
     # --- public API (reference routes scheduler/api.go:184-192) ---
 
@@ -78,8 +74,9 @@ class Scheduler:
         self.queue.push(task)
 
     def finish_job(self, job_id: str) -> None:
-        """`/finish/{taskId}`: evict the policy cache (api.go:165-176)."""
-        self._finished.append(job_id)
+        """`/finish/{taskId}`: evict the policy cache (api.go:165-176). The
+        policy also records the id so stale epoch-end updates still queued for
+        this job are dropped, not rescheduled."""
         self.policy.task_finished(job_id)
 
     def infer(self, model_id: str, data):
@@ -111,10 +108,11 @@ class Scheduler:
                 log.exception("scheduling task %s failed", task.job_id)
 
     def _schedule(self, task: TrainTask) -> None:
-        if task.state.elapsed_time >= 0 and task.job_id in self._finished:
+        decision = self.policy.calculate_parallelism(task)
+        if decision is None:
             log.debug("dropping stale update for finished job %s", task.job_id)
             return
-        parallelism, is_new = self.policy.calculate_parallelism(task)
+        parallelism, is_new = decision
         task.state.parallelism = parallelism
         if is_new:
             log.info("starting job %s with parallelism %d", task.job_id, parallelism)

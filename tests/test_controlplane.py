@@ -102,6 +102,18 @@ class TestPolicy:
         _, is_new = p.calculate_parallelism(_task())
         assert is_new
 
+    def test_stale_update_after_finish_is_dropped(self):
+        # an epoch-end update queued behind finish_job must return the drop
+        # sentinel, not reseed the cache / resurrect the job
+        p = ThroughputBasedPolicy(default_parallelism=4, max_parallelism=8)
+        p.calculate_parallelism(_task())
+        p.task_finished("j1")
+        assert p.calculate_parallelism(_task(parallelism=4, elapsed=10.0)) is None
+        assert "j1" not in p._time_cache
+        # a fresh submission reusing the id starts cleanly
+        par, is_new = p.calculate_parallelism(_task())
+        assert is_new and par == 4
+
 
 class TestQueue:
     def test_fifo(self):

@@ -1,0 +1,131 @@
+"""Numerics tests for the Pallas flash-attention kernel against the XLA oracle.
+
+On CPU the kernel runs in Pallas interpret mode (same kernel code path the TPU
+compiles); tolerances are f32-tight.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeml_tpu.ops.attention import dot_product_attention
+from kubeml_tpu.ops.flash_attention import flash_attention
+
+
+def qkv(rng, b=2, l=64, h=2, d=16, lk=None, dtype=np.float32):
+    lk = l if lk is None else lk
+    mk = lambda lx: rng.normal(size=(b, lx, h, d)).astype(dtype)
+    return mk(l), mk(lk), mk(lk)
+
+
+def oracle(q, k, v, causal=False, kv_valid=None):
+    return dot_product_attention(q, k, v, causal=causal, kv_valid=kv_valid, impl="xla")
+
+
+def test_flash_matches_xla_plain(rng):
+    q, k, v = qkv(rng)
+    np.testing.assert_allclose(
+        flash_attention(q, k, v), oracle(q, k, v), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_flash_causal(rng):
+    q, k, v = qkv(rng)
+    np.testing.assert_allclose(
+        flash_attention(q, k, v, causal=True),
+        oracle(q, k, v, causal=True),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_flash_kv_valid(rng):
+    q, k, v = qkv(rng)
+    valid = (rng.random(size=q.shape[:2]) > 0.3).astype(np.float32)
+    valid[:, 0] = 1.0  # keep at least one real token per row
+    np.testing.assert_allclose(
+        flash_attention(q, k, v, kv_valid=valid),
+        oracle(q, k, v, kv_valid=valid),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_flash_causal_and_valid_odd_lengths(rng):
+    # lengths not multiples of any block size exercise the padding path
+    q, k, v = qkv(rng, l=50)
+    valid = np.ones(q.shape[:2], np.float32)
+    valid[:, 40:] = 0.0
+    np.testing.assert_allclose(
+        flash_attention(q, k, v, causal=True, kv_valid=valid),
+        oracle(q, k, v, causal=True, kv_valid=valid),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_flash_cross_attention_lengths(rng):
+    q, k, v = qkv(rng, l=24, lk=72)
+    np.testing.assert_allclose(
+        flash_attention(q, k, v), oracle(q, k, v), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_flash_multiblock(rng):
+    # L > block sizes so the online-softmax recurrence actually iterates
+    q, k, v = qkv(rng, b=1, l=80, h=1, d=8)
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+    np.testing.assert_allclose(out, oracle(q, k, v, causal=True), rtol=1e-5, atol=1e-5)
+
+
+def test_flash_bf16(rng):
+    q, k, v = qkv(rng)
+    out = flash_attention(q.astype(jnp.bfloat16), k.astype(jnp.bfloat16), v.astype(jnp.bfloat16))
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        out.astype(np.float32), oracle(q, k, v), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_flash_gradients_match_xla(rng):
+    q, k, v = qkv(rng, b=1, l=32, h=1, d=8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(oracle(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gx):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+def test_flash_under_jit(rng):
+    q, k, v = qkv(rng)
+    out = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
+    np.testing.assert_allclose(out, oracle(q, k, v, causal=True), rtol=1e-5, atol=1e-5)
+
+
+def test_dispatch_rejects_dense_mask_on_pallas(rng):
+    q, k, v = qkv(rng)
+    with pytest.raises(ValueError):
+        dot_product_attention(q, k, v, mask=jnp.ones((1, 1, 64, 64), bool), impl="pallas")
+
+
+def test_structured_mask_xla_path_equivalence(rng):
+    # causal/kv_valid kwargs on the XLA path equal an explicitly built mask
+    q, k, v = qkv(rng)
+    valid = np.ones(q.shape[:2], np.float32)
+    valid[:, 50:] = 0.0
+    l = q.shape[1]
+    mask = (jnp.arange(l)[None, :] <= jnp.arange(l)[:, None])[None, None]
+    mask = mask & (valid[:, None, None, :] > 0)
+    np.testing.assert_allclose(
+        dot_product_attention(q, k, v, causal=True, kv_valid=valid, impl="xla"),
+        dot_product_attention(q, k, v, mask=mask, impl="xla"),
+        rtol=1e-6,
+        atol=1e-6,
+    )
