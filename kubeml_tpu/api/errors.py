@@ -69,6 +69,13 @@ class DatasetExistsError(KubeMLError):
         super().__init__(f"dataset {name!r} already exists" if name else "dataset exists")
 
 
+class CheckpointNotFoundError(KubeMLError):
+    status_code = 404
+
+    def __init__(self, ref: str = ""):
+        super().__init__(f"checkpoint {ref!r} not found" if ref else "checkpoint not found")
+
+
 class InvalidArgsError(KubeMLError):
     """Bad invocation arguments (reference: exceptions.py InvalidArgsError)."""
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import re
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -96,10 +97,16 @@ class TrainOptions(_JsonMixin):
     precision: str = "bf16"  # compute dtype for matmul/conv (MXU native)
     mesh_shape: Optional[Dict[str, int]] = None  # explicit mesh override {axis: size}
     donate: bool = True  # donate params buffers into the jitted step
+    # --- checkpoint/resume (closes reference gap SURVEY §5: weights died with job) ---
+    checkpoint_every: int = 0  # save a checkpoint every N epochs; 0 = off
+    resume: bool = False  # restore the latest checkpoint for this job id and continue
+    save_model: bool = True  # export the final model at job end (enables later infer)
 
     def __post_init__(self):
         if self.validate_every < 0:
             raise ValueError("validate_every must be >= 0")
+        if self.checkpoint_every < 0:
+            raise ValueError("checkpoint_every must be >= 0")
         if self.k == 0 or self.k < -1:
             raise ValueError("k must be -1 (sparse) or a positive step count")
         if self.mesh_shape is not None:
@@ -121,6 +128,9 @@ class TrainRequest(_JsonMixin):
     lr: float = 0.01
     function_name: str = ""
     options: TrainOptions = field(default_factory=TrainOptions)
+    # optional client-chosen job id (enables --resume to re-attach to an earlier
+    # job's checkpoints; empty -> the scheduler mints one)
+    job_id: str = ""
 
     def __post_init__(self):
         if isinstance(self.options, dict):
@@ -129,6 +139,8 @@ class TrainRequest(_JsonMixin):
     def validate(self) -> None:
         if not self.function_name:
             raise ValueError("function_name is required")
+        if self.job_id and not re.fullmatch(r"[A-Za-z0-9_-]{1,64}", self.job_id):
+            raise ValueError("job_id must be 1-64 chars of [A-Za-z0-9_-]")
         if not self.dataset:
             raise ValueError("dataset is required")
         if self.epochs < 1:

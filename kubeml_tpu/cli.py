@@ -40,8 +40,13 @@ def cmd_train(args) -> int:
     if not (0 < args.batch <= 1024):
         print("error: batch size must be in (0, 1024]", file=sys.stderr)
         return 1
+    if args.resume and not args.id:
+        print("error: --resume requires --id (the job id whose checkpoints to continue)",
+              file=sys.stderr)
+        return 1
     k = -1 if args.sparse_avg else args.k
     req = TrainRequest(
+        job_id=args.id or "",
         model_type=args.function,
         batch_size=args.batch,
         epochs=args.epochs,
@@ -54,6 +59,9 @@ def cmd_train(args) -> int:
             k=k,
             validate_every=args.validate_every,
             goal_accuracy=args.goal_accuracy,
+            checkpoint_every=args.checkpoint_every,
+            resume=args.resume,
+            save_model=not args.no_save_model,
         ),
     )
     job_id = _client(args).networks().train(req)
@@ -133,6 +141,26 @@ def cmd_history(args) -> int:
         print(f"pruned {c.prune()} histories")
     else:
         _print([h.to_dict() for h in c.list()])
+    return 0
+
+
+# --- checkpoint (TPU-native addition: the reference deletes all weights at job
+# end and cannot export a trained model — SURVEY §5) ---
+
+
+def cmd_checkpoint(args) -> int:
+    c = _client(args).checkpoints()
+    if args.action == "list":
+        if args.id:
+            _print({"job": args.id, "checkpoints": c.list(args.id)})
+        else:
+            _print(c.list())
+    elif args.action == "export":
+        dest = c.export(args.id, args.out, epoch=args.epoch)
+        print(f"exported {args.id} -> {dest}")
+    elif args.action == "delete":
+        c.delete(args.id)
+        print(f"deleted checkpoints of {args.id}")
     return 0
 
 
@@ -218,6 +246,14 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--sparse-avg", action="store_true", help="one sync per epoch (K=-1)")
     t.add_argument("--validate-every", type=int, default=1)
     t.add_argument("--goal-accuracy", type=float, default=100.0)
+    t.add_argument("--checkpoint-every", type=int, default=0,
+                   help="save a checkpoint every N epochs (0 = off)")
+    t.add_argument("--id", default=None,
+                   help="explicit job id (required for --resume; default: minted)")
+    t.add_argument("--resume", action="store_true",
+                   help="resume from --id's latest checkpoint")
+    t.add_argument("--no-save-model", action="store_true",
+                   help="skip the final model export")
     t.set_defaults(fn=cmd_train)
 
     i = sub.add_parser("infer", help="run inference against a trained job")
@@ -265,6 +301,18 @@ def build_parser() -> argparse.ArgumentParser:
     hsub.add_parser("list")
     hsub.add_parser("prune")
     h.set_defaults(fn=cmd_history)
+
+    c = sub.add_parser("checkpoint", help="manage saved models / checkpoints")
+    csub = c.add_subparsers(dest="action", required=True)
+    cl = csub.add_parser("list")
+    cl.add_argument("--id", default=None, help="job id (default: all jobs)")
+    ce = csub.add_parser("export")
+    ce.add_argument("--id", required=True)
+    ce.add_argument("--out", required=True, help="destination .npz path")
+    ce.add_argument("--epoch", type=int, default=None)
+    cd = csub.add_parser("delete")
+    cd.add_argument("--id", required=True)
+    c.set_defaults(fn=cmd_checkpoint)
 
     lg = sub.add_parser("logs", help="show cluster logs")
     lg.add_argument("--id", default=None, help="filter by job id")

@@ -151,6 +151,45 @@ class _Functions:
         _check(requests.delete(f"{self.c.url}/function/{name}", timeout=self.c.timeout))
 
 
+class _Checkpoints:
+    def __init__(self, client: "KubemlClient"):
+        self.c = client
+
+    def list(self, job_id: Optional[str] = None):
+        if job_id is None:
+            return _check(requests.get(f"{self.c.url}/checkpoint", timeout=self.c.timeout))
+        return _check(
+            requests.get(f"{self.c.url}/checkpoint/{job_id}", timeout=self.c.timeout)
+        )["checkpoints"]
+
+    def export(self, job_id: str, dest: Union[str, Path], epoch: Optional[int] = None,
+               tag: Optional[str] = None) -> Path:
+        params = {}
+        if epoch is not None:
+            params["epoch"] = str(epoch)
+        if tag is not None:
+            params["tag"] = tag
+        resp = requests.get(
+            f"{self.c.url}/checkpoint/{job_id}/export", params=params, timeout=self.c.timeout
+        )
+        if resp.status_code >= 400:
+            raise error_from_envelope(resp.content, resp.status_code)
+        from ..storage.checkpoint import normalize_npz
+
+        dest = normalize_npz(dest)
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        dest.write_bytes(resp.content)
+        return dest
+
+    def delete(self, job_id: str, tag: Optional[str] = None) -> None:
+        params = {"tag": tag} if tag else {}
+        _check(
+            requests.delete(
+                f"{self.c.url}/checkpoint/{job_id}", params=params, timeout=self.c.timeout
+            )
+        )
+
+
 class KubemlClient:
     """``KubemlClient(url)``; default URL from config (reference discovers the
     controller from the k8s service, client/util.go:18-63 — here it's config)."""
@@ -177,6 +216,9 @@ class KubemlClient:
 
     def functions(self) -> _Functions:
         return _Functions(self)
+
+    def checkpoints(self) -> _Checkpoints:
+        return _Checkpoints(self)
 
     def health(self) -> bool:
         try:

@@ -20,10 +20,11 @@ from ..api.config import Config, get_config
 from ..api.errors import KubeMLError
 from ..api.types import InferRequest, TrainRequest
 from ..functions.registry import FunctionRegistry
+from ..storage.checkpoint import CheckpointStore
 from ..storage.history import HistoryStore
 from ..storage.service import REQUIRED_FILES, decode_array, parse_multipart
 from ..storage.store import ShardStore
-from ..utils.httpd import Request, Router, Service
+from ..utils.httpd import Request, Response, Router, Service
 
 
 class Controller:
@@ -42,6 +43,7 @@ class Controller:
         self.store = store or ShardStore(config=self.cfg)
         self.history_store = history_store or HistoryStore(config=self.cfg)
         self.registry = registry or FunctionRegistry(config=self.cfg)
+        self.checkpoints = CheckpointStore(config=self.cfg)
 
         router = Router("controller")
         router.route("POST", "/train", self._train)
@@ -56,6 +58,10 @@ class Controller:
         router.route("GET", "/history/{id}", self._history_get)
         router.route("DELETE", "/history/{id}", self._history_delete)
         router.route("DELETE", "/history", self._history_prune)
+        router.route("GET", "/checkpoint", self._ckpt_list_all)
+        router.route("GET", "/checkpoint/{id}", self._ckpt_list)
+        router.route("GET", "/checkpoint/{id}/export", self._ckpt_export)
+        router.route("DELETE", "/checkpoint/{id}", self._ckpt_delete)
         router.route("GET", "/function", self._fn_list)
         router.route("GET", "/function/{name}", self._fn_get)
         router.route("POST", "/function/{name}", self._fn_create)
@@ -127,6 +133,33 @@ class Controller:
 
     def _history_prune(self, req: Request):
         return {"pruned": self.history_store.prune()}
+
+    # --- checkpoints (TPU-native: the reference deletes weights at job end and
+    # has no model export at all — SURVEY §5) ---
+
+    def _ckpt_list_all(self, req: Request):
+        return {j: self.checkpoints.tags(j) for j in self.checkpoints.list_jobs()}
+
+    def _ckpt_list(self, req: Request):
+        job = req.params["id"]
+        return {"job": job, "checkpoints": self.checkpoints.tags(job)}
+
+    def _ckpt_export(self, req: Request):
+        epoch_s = req.arg("epoch")
+        epoch = None
+        if epoch_s:
+            try:
+                epoch = int(epoch_s)
+            except ValueError:
+                raise KubeMLError(f"invalid epoch {epoch_s!r}", 400)
+        path = self.checkpoints.export_path(
+            req.params["id"], epoch=epoch, tag=req.arg("tag")
+        )
+        return Response(path.read_bytes(), content_type="application/octet-stream")
+
+    def _ckpt_delete(self, req: Request):
+        self.checkpoints.delete(req.params["id"], tag=req.arg("tag"))
+        return {"deleted": req.params["id"]}
 
     # --- functions ---
 

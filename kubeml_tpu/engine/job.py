@@ -32,6 +32,7 @@ from ..data.dataset import KubeDataset
 from ..data.loader import RoundLoader, validation_loader
 from ..data.sharding import plan_epoch
 from ..runtime.model import KubeModel
+from ..storage.checkpoint import FINAL_TAG, CheckpointStore
 from ..storage.history import HistoryStore
 from ..storage.store import ShardStore
 from .kavg import KAvgTrainer
@@ -47,6 +48,7 @@ class TrainJob:
         model: KubeModel,
         store: Optional[ShardStore] = None,
         history_store: Optional[HistoryStore] = None,
+        checkpoint_store: Optional[CheckpointStore] = None,
         on_epoch_end: Optional[Callable[[JobState], int]] = None,
         on_metrics: Optional[Callable[[MetricUpdate], None]] = None,
         devices=None,
@@ -57,6 +59,7 @@ class TrainJob:
         self.model = model
         self.store = store or ShardStore()
         self.history_store = history_store or HistoryStore()
+        self._checkpoint_store = checkpoint_store
         self.on_epoch_end = on_epoch_end
         self.on_metrics = on_metrics
         self.seed = seed
@@ -76,6 +79,12 @@ class TrainJob:
 
     def stop(self) -> None:
         self.stop_event.set()
+
+    @property
+    def checkpoint_store(self) -> CheckpointStore:
+        if self._checkpoint_store is None:
+            self._checkpoint_store = CheckpointStore()
+        return self._checkpoint_store
 
     @property
     def state(self) -> JobState:
@@ -101,9 +110,17 @@ class TrainJob:
                 rng, sample_x, self.parallelism
             )
 
+            # resume (TPU-native addition; the reference cannot — SURVEY §5):
+            # restore the latest checkpointed reference model + recorded history
+            # and continue from the following epoch
+            start_epoch = 0
+            if opts.resume:
+                start_epoch = self._restore_latest()
+
             val_acc = 0.0
             acc_pct = None
-            for epoch in range(req.epochs):
+            epochs_run = 0
+            for epoch in range(start_epoch, req.epochs):
                 if self.stop_event.is_set():
                     log.info("%s: stop requested, exiting at epoch %d", self.job_id, epoch)
                     break
@@ -136,6 +153,7 @@ class TrainJob:
                     val_acc, val_loss = self._validate(dataset, handle)
                     acc_pct = val_acc * 100.0
 
+                epochs_run += 1
                 self.history.append_epoch(
                     train_loss=train_loss,
                     parallelism=used_parallelism,
@@ -144,6 +162,8 @@ class TrainJob:
                     accuracy=acc_pct,
                 )
                 self._push_metrics(train_loss, val_loss, acc_pct, elapsed, used_parallelism)
+                if opts.checkpoint_every > 0 and (epoch + 1) % opts.checkpoint_every == 0:
+                    self._save_checkpoint(epoch)
                 log.info(
                     "%s: epoch %d/%d loss=%.4f acc=%s parallelism=%d %.2fs",
                     self.job_id, epoch + 1, req.epochs, train_loss,
@@ -160,10 +180,13 @@ class TrainJob:
                     break
 
             # final validation if the last epoch didn't run one (job.go:247-255);
-            # validate_every == 0 means the user opted out of validation entirely
+            # validate_every == 0 means the user opted out of validation entirely,
+            # and a resume that had nothing left to train must not append extra
+            # entries onto the restored (already-aligned) history
             if (
                 opts.validate_every > 0
                 and acc_pct is None
+                and epochs_run > 0
                 and not self.stop_event.is_set()
             ):
                 val_acc, val_loss = self._validate(dataset, handle)
@@ -171,6 +194,20 @@ class TrainJob:
                 self.history.accuracy.append(float(val_acc * 100.0))
 
             self._final_variables = self.trainer.reference_variables(self._stacked_vars)
+            # final model export (the reference deletes all weights at job end,
+            # util.go:211-244 — here a finished job stays inferable/exportable).
+            # A no-op resume skips the rewrite unless no final export exists yet
+            # (crash after the last epoch checkpoint but before the final save).
+            if opts.save_model and (
+                epochs_run > 0 or FINAL_TAG not in self.checkpoint_store.tags(self.job_id)
+            ):
+                self.checkpoint_store.save(
+                    self.job_id,
+                    self._final_variables,
+                    epoch=len(self.history.train_loss),
+                    tag=FINAL_TAG,
+                    meta={"request": req.to_dict(), "history": self._history_lists()},
+                )
         except KubeMLError as e:
             self.exit_error = e.message
             raise
@@ -228,6 +265,54 @@ class TrainJob:
         acc, loss = self.trainer.evaluate_rounds(self._stacked_vars, loader)
         dataset.set_mode(True)
         return acc, loss
+
+    def _history_lists(self) -> dict:
+        h = self.history
+        return {
+            "train_loss": list(h.train_loss),
+            "validation_loss": list(h.validation_loss),
+            "accuracy": list(h.accuracy),
+            "parallelism": list(h.parallelism),
+            "epoch_duration": list(h.epoch_duration),
+        }
+
+    def _save_checkpoint(self, epoch: int) -> None:
+        try:
+            self.checkpoint_store.save(
+                self.job_id,
+                self.trainer.reference_variables(self._stacked_vars),
+                epoch=epoch,
+                meta={"request": self.request.to_dict(), "history": self._history_lists()},
+            )
+        except Exception:
+            log.exception("%s: checkpoint save failed (non-fatal)", self.job_id)
+
+    def _restore_latest(self) -> int:
+        """Restore the newest checkpoint for this job id — an epoch checkpoint
+        (resume from epoch+1) or the final export (resume from its recorded
+        epoch count, so a default-options job with only ``final.npz`` resumes
+        too). Returns the epoch to resume from (0 = nothing to restore)."""
+        store = self.checkpoint_store
+        tags = store.tags(self.job_id)
+        if not tags:
+            return 0
+        best = None  # (start_epoch, Checkpoint)
+        last = store.latest_epoch(self.job_id)
+        if last is not None:
+            best = (last + 1, store.restore(self.job_id, epoch=last))
+        if FINAL_TAG in tags:
+            # final.epoch records completed-epoch count == next epoch index; it
+            # can trail the newest epoch checkpoint after a mid-run crash
+            ck_final = store.restore(self.job_id, tag=FINAL_TAG)
+            if best is None or ck_final.epoch > best[0]:
+                best = (ck_final.epoch, ck_final)
+        start_epoch, ck = best
+        self._stacked_vars = self.trainer.place_reference(ck.variables, self.parallelism)
+        for key, vals in ck.meta.get("history", {}).items():
+            if hasattr(self.history, key):
+                getattr(self.history, key).extend(vals)
+        log.info("%s: resumed from checkpoint %s (epoch %d)", self.job_id, ck.tag, start_epoch)
+        return start_epoch
 
     def _push_metrics(self, train_loss, val_loss, acc_pct, elapsed, parallelism) -> None:
         if self.on_metrics is None:

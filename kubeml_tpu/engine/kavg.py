@@ -147,6 +147,13 @@ class KAvgTrainer:
         sharded, _ = self._shardings(new_n)
         return jax.device_put(jax.tree.map(np.asarray, stacked), sharded)
 
+    def place_reference(self, variables, n_workers: int):
+        """Broadcast one reference replica (e.g. a restored checkpoint) across the
+        worker axis, sharded over the mesh — the inverse of reference_variables."""
+        stacked = _broadcast_to_workers(jax.tree.map(jnp.asarray, variables), n_workers)
+        sharded, _ = self._shardings(n_workers)
+        return jax.device_put(stacked, sharded)
+
     def reference_variables(self, stacked_vars):
         """One replica of the (post-sync) variables — the 'reference model'."""
         return jax.tree.map(lambda x: np.asarray(x[0]), stacked_vars)

@@ -31,11 +31,15 @@ from ..api.errors import JobNotFoundError, KubeMLError
 from ..api.types import JobState, JobStateEnum, MetricUpdate, TrainTask
 from ..engine.job import TrainJob
 from ..functions.registry import FunctionRegistry
+from ..storage.checkpoint import FINAL_TAG, CheckpointStore
 from ..storage.history import HistoryStore
 from ..storage.store import ShardStore
 from .metrics import MetricsRegistry
 
 log = logging.getLogger("kubeml.ps")
+
+# finished-job serving cache: full weight pytrees are big, keep only a few
+SERVING_CACHE_SIZE = 4
 
 # Seconds the job thread waits for the scheduler's parallelism answer before
 # keeping its current parallelism (the reference blocks forever on schedulerCh;
@@ -77,6 +81,8 @@ class ParameterServer:
         self.devices = devices
         self.scheduler = None  # bound after construction (circular dep)
         self._jobs: Dict[str, _JobRecord] = {}
+        self._serving_cache: Dict[str, tuple] = {}  # (model, vars, ckpt mtime)
+        self._ckpt_store = CheckpointStore(config=self.cfg)
         self._lock = threading.RLock()
 
     def bind_scheduler(self, scheduler) -> None:
@@ -97,6 +103,8 @@ class ParameterServer:
             if task.job_id in self._jobs:
                 raise KubeMLError(f"job {task.job_id} already exists", 400)
             self._jobs[task.job_id] = placeholder
+            # a restarted job id invalidates any cached finished-model weights
+            self._serving_cache.pop(task.job_id, None)
         try:
             model = self.registry.load(req.function_name)
             model._set_params(
@@ -111,6 +119,7 @@ class ParameterServer:
                 model,
                 store=self.store,
                 history_store=self.history_store,
+                checkpoint_store=self._ckpt_store,
                 on_epoch_end=lambda state, jid=task.job_id: self._epoch_end(jid, state),
                 on_metrics=self.metrics.update,
                 devices=self.devices,
@@ -232,15 +241,60 @@ class ParameterServer:
         return not record.thread.is_alive()
 
     def infer(self, model_id: str, data) -> list:
-        """`/infer` serving path: run the (live) job's current model."""
+        """`/infer` serving path: run the live job's current model, or — once the
+        job has finished — its exported final checkpoint (the reference can only
+        serve live jobs because weights are deleted at job end, util.go:211-244)."""
         with self._lock:
             record = self._jobs.get(model_id)
         if record is None:
-            raise JobNotFoundError(model_id)
+            return self._infer_from_checkpoint(model_id, data)
         if record.job is None:
             raise KubeMLError(f"job {model_id} is still starting", 503)
         self.metrics.task_started("inference")
         try:
             return np.asarray(record.job.infer(np.asarray(data))).tolist()
+        finally:
+            self.metrics.task_finished("inference")
+
+    def _infer_from_checkpoint(self, model_id: str, data) -> list:
+        import jax.numpy as jnp
+
+        from ..api.errors import CheckpointNotFoundError, StorageError
+
+        store = self._ckpt_store
+
+        def current_mtime():
+            """None when the final checkpoint no longer exists on disk (or the
+            id is malformed — an unknown model is a 404, never a 500)."""
+            try:
+                return store.export_path(model_id, tag=FINAL_TAG).stat().st_mtime_ns
+            except (CheckpointNotFoundError, StorageError, OSError):
+                return None
+
+        mtime = current_mtime()
+        with self._lock:
+            cached = self._serving_cache.get(model_id)
+            if cached is not None and cached[2] != mtime:
+                cached = None  # checkpoint deleted or replaced since caching
+                self._serving_cache.pop(model_id, None)
+        if mtime is None:
+            raise JobNotFoundError(model_id)
+        if cached is None:
+            try:
+                ck = store.restore(model_id, tag=FINAL_TAG)
+            except CheckpointNotFoundError:
+                raise JobNotFoundError(model_id)
+            fn_name = ck.meta.get("request", {}).get("function_name", "")
+            model = self.registry.load(fn_name)
+            cached = (model, ck.variables, mtime)
+            with self._lock:
+                self._serving_cache[model_id] = cached
+                while len(self._serving_cache) > SERVING_CACHE_SIZE:
+                    self._serving_cache.pop(next(iter(self._serving_cache)))
+        model, variables = cached[0], cached[1]
+        self.metrics.task_started("inference")
+        try:
+            x = jnp.asarray(np.asarray(data))
+            return np.asarray(model.infer(variables, x)).tolist()
         finally:
             self.metrics.task_finished("inference")

@@ -34,6 +34,11 @@ class TaskQueue:
                 return None
             return self._q.popleft()
 
+    def job_ids(self) -> set:
+        """Snapshot of the job ids currently queued (duplicate-submit guard)."""
+        with self._cond:
+            return {t.job_id for t in self._q}
+
     def __len__(self) -> int:
         with self._cond:
             return len(self._q)

@@ -57,13 +57,33 @@ class Scheduler:
         self.queue = TaskQueue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # ids alive anywhere in the pipeline (queued, popped-in-flight, or
+        # running) — the duplicate-submit guard must cover the pop->start_task
+        # window that neither queue.job_ids() nor ps.list_tasks() sees
+        self._active_ids: set = set()
+        self._active_lock = threading.Lock()
 
     # --- public API (reference routes scheduler/api.go:184-192) ---
 
     def submit_train(self, request: TrainRequest) -> str:
-        """`/train`: validate, mint job id, enqueue (api.go:78-116)."""
+        """`/train`: validate, mint job id, enqueue (api.go:78-116).
+
+        A client-supplied ``request.job_id`` is honored (TPU-native addition so
+        ``--resume`` can re-attach to an existing job's checkpoints; the
+        reference always mints, util.go:8-10) — but rejected with 409 while a
+        job with that id is still queued or running, so a duplicate submission
+        fails at /train instead of silently dying in the scheduler loop."""
         request.validate()
-        job_id = create_job_id()
+        with self._active_lock:
+            if request.job_id and (
+                request.job_id in self._active_ids
+                or any(t.job_id == request.job_id for t in self.ps.list_tasks())
+            ):
+                from ..api.errors import KubeMLError
+
+                raise KubeMLError(f"job {request.job_id!r} is still active", 409)
+            job_id = request.job_id or create_job_id()
+            self._active_ids.add(job_id)
         task = TrainTask(job_id=job_id, parameters=request, state=JobState())
         self.queue.push(task)
         log.info("queued train task %s (%s on %s)", job_id, request.function_name, request.dataset)
@@ -78,6 +98,8 @@ class Scheduler:
         policy also records the id so stale epoch-end updates still queued for
         this job are dropped, not rescheduled."""
         self.policy.task_finished(job_id)
+        with self._active_lock:
+            self._active_ids.discard(job_id)
 
     def infer(self, model_id: str, data):
         """`/infer`: bypasses the queue straight to the serving path (api.go:119-162)."""
@@ -116,7 +138,13 @@ class Scheduler:
         task.state.parallelism = parallelism
         if is_new:
             log.info("starting job %s with parallelism %d", task.job_id, parallelism)
-            self.ps.start_task(task)
+            try:
+                self.ps.start_task(task)
+            except Exception:
+                # a start that never spawned a job thread will get no finish
+                # callback — release the id so the client can resubmit
+                self.finish_job(task.job_id)
+                raise
         else:
             log.debug("job %s parallelism -> %d", task.job_id, parallelism)
             self.ps.update_task(task.job_id, parallelism)

@@ -1,3 +1,4 @@
+from .checkpoint import Checkpoint, CheckpointStore  # noqa: F401
 from .store import DatasetHandle, ShardStore  # noqa: F401
 from .history import HistoryStore  # noqa: F401
 from .service import StorageService  # noqa: F401

@@ -1,0 +1,324 @@
+"""Checkpoint/resume subsystem tests — store roundtrips, TrainJob periodic saves,
+resume-from-latest, final model export, and finished-job inference (the reference
+deletes all weights at job end, ml/pkg/train/util.go:211-244; this closes that gap)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from kubeml_tpu.api.errors import CheckpointNotFoundError
+from kubeml_tpu.storage.checkpoint import FINAL_TAG, CheckpointStore
+
+from test_job import KubeLeNet, _request, mnist_store, synthetic_mnist  # noqa: F401
+
+
+def tree(seed=0):
+    r = np.random.default_rng(seed)
+    import ml_dtypes
+
+    return {
+        "params": {
+            "dense": {
+                "kernel": r.normal(size=(4, 3)).astype(np.float32),
+                "bias": r.normal(size=(3,)).astype(ml_dtypes.bfloat16),
+            }
+        },
+        "batch_stats": {"bn": {"count": np.array([7], np.int64)}},
+    }
+
+
+def assert_tree_equal(a, b):
+    assert sorted(a) == sorted(b)
+    for k in a:
+        if isinstance(a[k], dict):
+            assert_tree_equal(a[k], b[k])
+        else:
+            assert a[k].dtype == b[k].dtype
+            np.testing.assert_array_equal(np.asarray(a[k], np.float64), np.asarray(b[k], np.float64))
+
+
+def test_save_restore_roundtrip(tmp_config):
+    store = CheckpointStore(config=tmp_config)
+    t = tree()
+    store.save("jobabc", t, epoch=3, meta={"note": "hi"})
+    ck = store.restore("jobabc")
+    assert ck.epoch == 3
+    assert ck.meta == {"note": "hi"}
+    assert_tree_equal(ck.variables, t)
+
+
+def test_latest_and_explicit_epoch(tmp_config):
+    store = CheckpointStore(config=tmp_config)
+    store.save("j", tree(1), epoch=1)
+    store.save("j", tree(2), epoch=2)
+    assert store.latest_epoch("j") == 2
+    assert store.epochs("j") == [1, 2]
+    ck1 = store.restore("j", epoch=1)
+    assert_tree_equal(ck1.variables, tree(1))
+    assert_tree_equal(store.restore("j").variables, tree(2))
+
+
+def test_final_tag_preferred(tmp_config):
+    store = CheckpointStore(config=tmp_config)
+    store.save("j", tree(1), epoch=5)
+    store.save("j", tree(9), epoch=6, tag=FINAL_TAG)
+    assert_tree_equal(store.restore("j").variables, tree(9))
+    assert sorted(store.tags("j")) == ["ep00005", FINAL_TAG]
+
+
+def test_missing_checkpoint_raises(tmp_config):
+    store = CheckpointStore(config=tmp_config)
+    with pytest.raises(CheckpointNotFoundError):
+        store.restore("nope")
+    with pytest.raises(CheckpointNotFoundError):
+        store.delete("nope")
+
+
+def test_overwrite_same_tag(tmp_config):
+    store = CheckpointStore(config=tmp_config)
+    store.save("j", tree(1), epoch=0, tag=FINAL_TAG)
+    store.save("j", tree(2), epoch=0, tag=FINAL_TAG)
+    assert_tree_equal(store.restore("j", tag=FINAL_TAG).variables, tree(2))
+
+
+def test_export_single_file_roundtrip(tmp_config, tmp_path):
+    store = CheckpointStore(config=tmp_config)
+    store.save("j", tree(4), epoch=2, meta={"request": {"lr": 0.1}})
+    out = store.export("j", tmp_path / "model.npz")
+    assert out.exists()
+    ck = CheckpointStore.load_export(out)
+    assert ck.epoch == 2
+    assert ck.meta["request"]["lr"] == 0.1
+    assert_tree_equal(ck.variables, tree(4))
+
+
+def test_list_and_delete(tmp_config):
+    store = CheckpointStore(config=tmp_config)
+    store.save("a", tree(), epoch=0)
+    store.save("b", tree(), epoch=0)
+    assert store.list_jobs() == ["a", "b"]
+    store.delete("a")
+    assert store.list_jobs() == ["b"]
+
+
+# --- TrainJob integration ---
+
+
+def _job(job_id, req, store, cfg, **kw):
+    from kubeml_tpu.engine.job import TrainJob
+    from kubeml_tpu.storage import HistoryStore
+
+    return TrainJob(
+        job_id, req, KubeLeNet(), store=store,
+        history_store=HistoryStore(config=cfg),
+        checkpoint_store=CheckpointStore(config=cfg), **kw,
+    )
+
+
+def test_job_saves_final_model_and_periodic(mnist_store, tmp_config):
+    req = _request(
+        epochs=2,
+        options={"default_parallelism": 1, "static_parallelism": True, "k": 4,
+                 "checkpoint_every": 1},
+    )
+    job = _job("ckjob1", req, mnist_store, tmp_config)
+    job.train()
+    store = CheckpointStore(config=tmp_config)
+    assert store.epochs("ckjob1") == [0, 1]
+    assert FINAL_TAG in store.tags("ckjob1")
+    ck = store.restore("ckjob1", tag=FINAL_TAG)
+    assert ck.meta["request"]["function_name"] == "lenet"
+    assert len(ck.meta["history"]["train_loss"]) == 2
+
+
+def test_job_resume_continues_from_checkpoint(mnist_store, tmp_config):
+    opts = {"default_parallelism": 2, "static_parallelism": True, "k": 4,
+            "checkpoint_every": 1}
+    req1 = _request(epochs=2, options=dict(opts))
+    _job("ckjob2", req1, mnist_store, tmp_config).train()
+
+    # second run: same job id, more epochs, resume -> continues at epoch 2
+    req2 = _request(epochs=4, options=dict(opts, resume=True))
+    job2 = _job("ckjob2", req2, mnist_store, tmp_config)
+    hist = job2.train()
+    assert len(hist.train_loss) == 4  # 2 restored + 2 new
+    store = CheckpointStore(config=tmp_config)
+    assert store.epochs("ckjob2") == [0, 1, 2, 3]
+
+
+def test_resume_with_no_checkpoint_starts_fresh(mnist_store, tmp_config):
+    req = _request(epochs=1, options={"default_parallelism": 1,
+                                      "static_parallelism": True, "k": 4,
+                                      "resume": True})
+    hist = _job("ckjob3", req, mnist_store, tmp_config).train()
+    assert len(hist.train_loss) == 1
+
+
+def test_no_save_model_opt_out(mnist_store, tmp_config):
+    req = _request(epochs=1, options={"default_parallelism": 1,
+                                      "static_parallelism": True, "k": 4,
+                                      "save_model": False})
+    _job("ckjob4", req, mnist_store, tmp_config).train()
+    assert CheckpointStore(config=tmp_config).tags("ckjob4") == []
+
+
+def test_infer_from_finished_job_checkpoint(mnist_store, tmp_config):
+    """PS serves a finished job's model from its final checkpoint."""
+    from kubeml_tpu.functions.registry import FunctionRegistry
+    from kubeml_tpu.ps.parameter_server import ParameterServer
+    from kubeml_tpu.api.types import TrainTask
+
+    src = (
+        "import numpy as np, optax\n"
+        "from kubeml_tpu.runtime.model import KubeModel\n"
+        "from kubeml_tpu.data.dataset import KubeDataset\n"
+        "from kubeml_tpu.models.lenet import LeNet\n"
+        "class Ds(KubeDataset):\n"
+        "    def __init__(self):\n"
+        "        super().__init__('mnist')\n"
+        "    def transform(self, x, y):\n"
+        "        return x.astype(np.float32), y\n"
+        "class Model(KubeModel):\n"
+        "    def __init__(self):\n"
+        "        super().__init__(Ds())\n"
+        "    def build(self):\n"
+        "        return LeNet(num_classes=10)\n"
+        "    def configure_optimizers(self):\n"
+        "        return optax.sgd(self.lr, momentum=0.9)\n"
+    )
+    registry = FunctionRegistry(config=tmp_config)
+    registry.create("lenetfn", src)
+    ps = ParameterServer(registry=registry, store=mnist_store, config=tmp_config)
+    req = _request(epochs=1, options={"default_parallelism": 1,
+                                      "static_parallelism": True, "k": 4})
+    req.function_name = "lenetfn"
+    task = TrainTask(job_id="ckjob5", parameters=req)
+    ps.start_task(task)
+    assert ps.wait("ckjob5", timeout=300)
+
+    x, _ = synthetic_mnist(4, seed=9)
+    preds = ps.infer("ckjob5", x.tolist())
+    assert len(preds) == 4
+    assert all(0 <= p < 10 for p in preds)
+
+
+def test_resume_from_final_only(mnist_store, tmp_config):
+    """A job trained with default options (only final.npz) still resumes."""
+    opts = {"default_parallelism": 1, "static_parallelism": True, "k": 4}
+    _job("ckfin", _request(epochs=2, options=dict(opts)), mnist_store, tmp_config).train()
+    store = CheckpointStore(config=tmp_config)
+    assert store.epochs("ckfin") == []  # no periodic checkpoints
+    hist = _job("ckfin", _request(epochs=3, options=dict(opts, resume=True)),
+                mnist_store, tmp_config).train()
+    assert len(hist.train_loss) == 3  # 2 restored + 1 new
+
+
+def test_noop_resume_keeps_history_aligned(mnist_store, tmp_config):
+    """Resume with no epochs left must not append extra validation entries."""
+    opts = {"default_parallelism": 1, "static_parallelism": True, "k": 4,
+            "checkpoint_every": 1}
+    _job("cknop", _request(epochs=2, options=dict(opts)), mnist_store, tmp_config).train()
+    hist = _job("cknop", _request(epochs=2, options=dict(opts, resume=True)),
+                mnist_store, tmp_config).train()
+    assert len(hist.train_loss) == 2
+    assert len(hist.accuracy) == len(hist.train_loss)
+    assert len(hist.validation_loss) == len(hist.train_loss)
+
+
+def test_duplicate_job_id_rejected_while_active(mnist_store, tmp_config):
+    """Submitting an explicit job id that is still running returns 409."""
+    from kubeml_tpu.api.errors import KubeMLError
+    from kubeml_tpu.functions.registry import FunctionRegistry
+    from kubeml_tpu.ps.parameter_server import ParameterServer
+    from kubeml_tpu.scheduler.scheduler import Scheduler
+
+    class _StubPS:
+        def __init__(self):
+            self.tasks = []
+
+        def list_tasks(self):
+            return self.tasks
+
+        def start_task(self, task):
+            self.tasks.append(task)
+
+        def update_task(self, job_id, p):
+            pass
+
+    ps = _StubPS()
+    sched = Scheduler(ps, config=tmp_config, max_parallelism=8)
+    req = _request(epochs=1, options={"default_parallelism": 1})
+    req.job_id = "dupjob"
+    assert sched.submit_train(req) == "dupjob"
+    # still queued (scheduler loop not started) -> second submit rejected
+    with pytest.raises(KubeMLError) as ei:
+        sched.submit_train(req)
+    assert ei.value.status_code == 409
+
+
+def test_infer_404_after_checkpoint_delete(mnist_store, tmp_config):
+    """The PS serving cache revalidates against the file: delete -> 404."""
+    from kubeml_tpu.api.errors import JobNotFoundError
+    from kubeml_tpu.functions.registry import FunctionRegistry
+    from kubeml_tpu.ps.parameter_server import ParameterServer
+    from kubeml_tpu.api.types import TrainTask
+
+    src = (
+        "import numpy as np, optax\n"
+        "from kubeml_tpu.runtime.model import KubeModel\n"
+        "from kubeml_tpu.data.dataset import KubeDataset\n"
+        "from kubeml_tpu.models.lenet import LeNet\n"
+        "class Ds(KubeDataset):\n"
+        "    def __init__(self):\n"
+        "        super().__init__('mnist')\n"
+        "    def transform(self, x, y):\n"
+        "        return x.astype(np.float32), y\n"
+        "class Model(KubeModel):\n"
+        "    def __init__(self):\n"
+        "        super().__init__(Ds())\n"
+        "    def build(self):\n"
+        "        return LeNet(num_classes=10)\n"
+    )
+    registry = FunctionRegistry(config=tmp_config)
+    registry.create("cachefn", src)
+    ps = ParameterServer(registry=registry, store=mnist_store, config=tmp_config)
+    req = _request(epochs=1, options={"default_parallelism": 1,
+                                      "static_parallelism": True, "k": 4})
+    req.function_name = "cachefn"
+    ps.start_task(TrainTask(job_id="ckdel", parameters=req))
+    assert ps.wait("ckdel", timeout=300)
+
+    x, _ = synthetic_mnist(2, seed=3)
+    assert len(ps.infer("ckdel", x.tolist())) == 2  # populates the cache
+    CheckpointStore(config=tmp_config).delete("ckdel")
+    with pytest.raises(JobNotFoundError):
+        ps.infer("ckdel", x.tolist())
+
+
+def test_cli_checkpoint_list_and_export(mnist_store, tmp_config, tmp_path, capsys):
+    """Checkpoint commands route through the controller HTTP API (so --url works
+    against a remote cluster), and export lands a loadable single-file .npz."""
+    from kubeml_tpu.cli import main
+    from kubeml_tpu.cluster import LocalCluster
+
+    req = _request(epochs=1, options={"default_parallelism": 1,
+                                      "static_parallelism": True, "k": 4})
+    _job("ckjob6", req, mnist_store, tmp_config).train()
+
+    with LocalCluster(config=tmp_config) as cluster:
+        url = ["--url", cluster.controller_url]
+        assert main(url + ["checkpoint", "list", "--id", "ckjob6"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert FINAL_TAG in out["checkpoints"]
+
+        # suffixless dest: client normalizes to .npz and reports the real path
+        dest = tmp_path / "exported"
+        assert main(url + ["checkpoint", "export", "--id", "ckjob6", "--out", str(dest)]) == 0
+        real = tmp_path / "exported.npz"
+        assert real.exists()
+        ck = CheckpointStore.load_export(real)
+        assert "params" in ck.variables
+
+        assert main(url + ["checkpoint", "delete", "--id", "ckjob6"]) == 0
+        assert CheckpointStore(config=tmp_config).tags("ckjob6") == []

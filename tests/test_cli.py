@@ -59,10 +59,39 @@ def test_cli_full_flow(cluster, tmp_path, capsys):
     out = capsys.readouterr().out
     assert "train_loss" in out
 
-    # infer on a finished job 404s cleanly (model no longer resident)
+    # infer on a finished job serves from its final checkpoint (the reference
+    # 404s here because weights are deleted at job end, util.go:211-244)
     datafile = tmp_path / "infer.npy"
     np.save(datafile, make_blobs(4, shape=(8, 8, 1))[0])
-    assert main(url + ["infer", "-n", job_id, "--datafile", str(datafile)]) == 1
+    assert main(url + ["infer", "-n", job_id, "--datafile", str(datafile)]) == 0
+    preds = capsys.readouterr().out
+    assert "[" in preds
+
+    # but an unknown model id still 404s cleanly
+    assert main(url + ["infer", "-n", "nosuchjob", "--datafile", str(datafile)]) == 1
+
+    # resume: train with an explicit --id + checkpoints, then continue it
+    assert main(url + [
+        "train", "-f", "tiny", "-d", "blobs", "-e", "1", "-b", "16",
+        "--lr", "0.05", "-p", "2", "--static", "-K", "2",
+        "--id", "resumejob", "--checkpoint-every", "1",
+    ]) == 0
+    assert capsys.readouterr().out.strip().splitlines()[-1] == "resumejob"
+    _wait_done(KubemlClient(cluster.controller_url), "resumejob")
+    assert main(url + [
+        "train", "-f", "tiny", "-d", "blobs", "-e", "3", "-b", "16",
+        "--lr", "0.05", "-p", "2", "--static", "-K", "2",
+        "--id", "resumejob", "--checkpoint-every", "1", "--resume",
+    ]) == 0
+    capsys.readouterr()
+    _wait_done(KubemlClient(cluster.controller_url), "resumejob")
+    assert main(url + ["history", "get", "--id", "resumejob"]) == 0
+    import json as _json
+    hist = _json.loads(capsys.readouterr().out)
+    assert len(hist["train_loss"]) == 3  # 1 restored + 2 new
+
+    # --resume without --id is rejected up front
+    assert main(url + ["train", "-f", "tiny", "-d", "blobs", "--resume"]) == 1
 
     assert main(url + ["history", "prune"]) == 0
     assert main(url + ["task", "list", "--short"]) == 0
