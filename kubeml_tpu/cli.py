@@ -154,7 +154,7 @@ def cmd_checkpoint(args) -> int:
         if args.id:
             _print({"job": args.id, "checkpoints": c.list(args.id)})
         else:
-            _print(c.list())
+            _print(c.list_jobs())
     elif args.action == "export":
         dest = c.export(args.id, args.out, epoch=args.epoch)
         print(f"exported {args.id} -> {dest}")

@@ -155,12 +155,15 @@ class _Checkpoints:
     def __init__(self, client: "KubemlClient"):
         self.c = client
 
-    def list(self, job_id: Optional[str] = None):
-        if job_id is None:
-            return _check(requests.get(f"{self.c.url}/checkpoint", timeout=self.c.timeout))
+    def list(self, job_id: str) -> List[str]:
+        """Checkpoint tags of one job."""
         return _check(
             requests.get(f"{self.c.url}/checkpoint/{job_id}", timeout=self.c.timeout)
         )["checkpoints"]
+
+    def list_jobs(self) -> dict:
+        """All jobs with checkpoints -> their tags."""
+        return _check(requests.get(f"{self.c.url}/checkpoint", timeout=self.c.timeout))
 
     def export(self, job_id: str, dest: Union[str, Path], epoch: Optional[int] = None,
                tag: Optional[str] = None) -> Path:

@@ -1,0 +1,24 @@
+"""Native (C++) data-plane: parallel round packing and the tensor KV store.
+
+See ``native/kubeml_native.cpp`` for the implementation and
+:mod:`kubeml_tpu.native.bindings` for the Python surface. Everything degrades
+to pure-Python fallbacks when no C++ toolchain is present.
+"""
+
+from .bindings import (  # noqa: F401
+    TensorClient,
+    TensorServer,
+    TensorStore,
+    get_lib,
+    native_available,
+    pack_rounds,
+)
+
+__all__ = [
+    "TensorClient",
+    "TensorServer",
+    "TensorStore",
+    "get_lib",
+    "native_available",
+    "pack_rounds",
+]

@@ -1,0 +1,425 @@
+"""ctypes bindings + Python fallbacks for the native data-plane.
+
+Exposes three surfaces:
+
+* :func:`pack_rounds` — parallel gather/pad of per-worker sample slices into the
+  uniform round tensor (native ``kml_pack``; numpy fallback);
+* :class:`TensorStore` — in-process tensor KV with the reference RedisAI key
+  semantics (reference: ml/pkg/model/utils.go:140-158 key scheme,
+  ml/pkg/train/util.go:211-244 prefix delete); native C++ store or a
+  dict-based fallback with the same API;
+* :class:`TensorServer` / :class:`TensorClient` — the KV served over a unix
+  domain socket for multi-process deployments (the role redisai.kubeml:6379
+  plays in the reference cluster, api/const.go:12-13).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .build import library_path
+
+_MAX_NDIM = 8
+_DTYPE_BUF = 17
+
+
+def _bind(path) -> ctypes.CDLL:
+    lib = ctypes.CDLL(str(path))
+    lib.kml_pack.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_int32,
+    ]
+    lib.kml_pack.restype = None
+    lib.kml_store_new.restype = ctypes.c_int64
+    lib.kml_store_free.argtypes = [ctypes.c_int64]
+    lib.kml_store_set.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+        ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.kml_store_set.restype = ctypes.c_int32
+    lib.kml_store_meta.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.kml_store_meta.restype = ctypes.c_int32
+    lib.kml_store_get.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.kml_store_get.restype = ctypes.c_int64
+    lib.kml_store_del.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+    lib.kml_store_del.restype = ctypes.c_int32
+    lib.kml_store_del_prefix.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+    lib.kml_store_del_prefix.restype = ctypes.c_int64
+    lib.kml_store_keys.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+    ]
+    lib.kml_store_keys.restype = ctypes.c_int64
+    lib.kml_store_count.argtypes = [ctypes.c_int64]
+    lib.kml_store_count.restype = ctypes.c_int64
+    lib.kml_store_bytes.argtypes = [ctypes.c_int64]
+    lib.kml_store_bytes.restype = ctypes.c_int64
+    lib.kml_server_start.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+    lib.kml_server_start.restype = ctypes.c_int64
+    lib.kml_server_stop.argtypes = [ctypes.c_int64]
+    return lib
+
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def get_lib(block: bool = True) -> Optional[ctypes.CDLL]:
+    """The bound native library, or None.
+
+    ``block=False`` (the data-path mode) never waits on a compile: it returns
+    None while the background build runs and picks the library up once built.
+    """
+    global _lib, _lib_failed
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _lib_failed:
+            return None
+        path = library_path(block=block)
+        if path is None:
+            if block:
+                _lib_failed = True  # definitive: toolchain missing / build failed
+            return None
+        try:
+            _lib = _bind(path)
+        except OSError:
+            _lib_failed = True
+        return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# pack_rounds
+# ---------------------------------------------------------------------------
+
+
+def pack_rounds(
+    dst: np.ndarray,
+    srcs: Sequence[Optional[np.ndarray]],
+    counts: Sequence[int],
+    n_threads: int = 0,
+    native: bool = True,
+) -> None:
+    """Fill ``dst`` of shape [N, per_round, ...]: worker w gets ``srcs[w][:counts[w]]``
+    then zero padding. ``srcs[w]`` may be None (fully padded worker). Native
+    parallel memcpy when available (and ``native`` is True); numpy otherwise."""
+    n, per_round = dst.shape[0], dst.shape[1]
+    if len(srcs) != n or len(counts) != n:
+        raise ValueError("srcs/counts length must equal dst.shape[0]")
+    lib = get_lib(block=False) if native else None
+    item_bytes = int(np.prod(dst.shape[2:], dtype=np.int64)) * dst.dtype.itemsize
+    if lib is not None and dst.flags["C_CONTIGUOUS"]:
+        held: List[np.ndarray] = []  # keep contiguous copies alive over the call
+        ptrs = (ctypes.c_void_p * n)()
+        cts = (ctypes.c_int64 * n)()
+        ok = True
+        for w, (s, c) in enumerate(zip(srcs, counts)):
+            if s is None or c <= 0:
+                ptrs[w] = None
+                cts[w] = 0
+                continue
+            s = np.ascontiguousarray(s)
+            if s.dtype != dst.dtype or s.shape[1:] != dst.shape[2:]:
+                ok = False
+                break
+            held.append(s)
+            ptrs[w] = s.ctypes.data_as(ctypes.c_void_p)
+            # clamp to the actual source length too — an oversized count must
+            # never become an out-of-bounds read (the numpy path is safe by
+            # construction, native must match)
+            cts[w] = min(int(c), per_round, len(s))
+        if ok:
+            if n_threads <= 0:
+                n_threads = min(n, os.cpu_count() or 1)
+            lib.kml_pack(
+                dst.ctypes.data_as(ctypes.c_void_p), ptrs, cts,
+                ctypes.c_int64(per_round), ctypes.c_int64(item_bytes),
+                ctypes.c_int32(n), ctypes.c_int32(n_threads),
+            )
+            return
+    # numpy fallback
+    for w, (s, c) in enumerate(zip(srcs, counts)):
+        c = min(int(c), per_round) if s is not None else 0
+        if c > 0:
+            dst[w, :c] = s[:c]
+        if c < per_round:
+            dst[w, c:] = 0
+
+
+# ---------------------------------------------------------------------------
+# TensorStore
+# ---------------------------------------------------------------------------
+
+
+class TensorStore:
+    """Named-tensor KV with RedisAI-parity semantics. Backed by the native C++
+    store when available, else a locked dict with identical behavior."""
+
+    def __init__(self):
+        self._lib = get_lib()
+        if self._lib is not None:
+            self._h = self._lib.kml_store_new()
+        else:
+            self._h = None
+            self._map: Dict[str, np.ndarray] = {}
+            self._mu = threading.Lock()
+
+    @property
+    def native(self) -> bool:
+        return self._h is not None
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.kml_store_free(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def set(self, key: str, value: np.ndarray) -> None:
+        value = np.ascontiguousarray(value)
+        if self._h is None:
+            with self._mu:
+                self._map[key] = value.copy()
+            return
+        shape = (ctypes.c_int64 * _MAX_NDIM)(*value.shape)
+        rc = self._lib.kml_store_set(
+            self._h, key.encode(), str(value.dtype).encode(), shape,
+            ctypes.c_int32(value.ndim),
+            value.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(value.nbytes),
+        )
+        if rc != 0:
+            raise RuntimeError(f"tensorstore set({key!r}) failed: {rc}")
+
+    def get(self, key: str) -> Optional[np.ndarray]:
+        if self._h is None:
+            with self._mu:
+                v = self._map.get(key)
+            return v.copy() if v is not None else None
+        dtype_buf = ctypes.create_string_buffer(_DTYPE_BUF)
+        shape = (ctypes.c_int64 * _MAX_NDIM)()
+        ndim = ctypes.c_int32()
+        nbytes = ctypes.c_int64()
+        rc = self._lib.kml_store_meta(
+            self._h, key.encode(), dtype_buf, shape, ctypes.byref(ndim), ctypes.byref(nbytes)
+        )
+        if rc == -1:
+            return None
+        if rc != 0:
+            raise RuntimeError(f"tensorstore meta({key!r}) failed: {rc}")
+        dt = np.dtype(dtype_buf.value.decode())
+        out = np.empty(tuple(shape[i] for i in range(ndim.value)), dtype=dt)
+        got = self._lib.kml_store_get(
+            self._h, key.encode(), out.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int64(out.nbytes),
+        )
+        if got == -1:
+            return None  # deleted between meta and get
+        if got < 0:
+            raise RuntimeError(f"tensorstore get({key!r}) failed: {got}")
+        return out
+
+    def delete(self, key: str) -> bool:
+        if self._h is None:
+            with self._mu:
+                return self._map.pop(key, None) is not None
+        return self._lib.kml_store_del(self._h, key.encode()) == 0
+
+    def delete_prefix(self, prefix: str) -> int:
+        """The reference's clearTensors: DEL jobId* (train/util.go:211-244)."""
+        if self._h is None:
+            with self._mu:
+                keys = [k for k in self._map if k.startswith(prefix)]
+                for k in keys:
+                    del self._map[k]
+                return len(keys)
+        return int(self._lib.kml_store_del_prefix(self._h, prefix.encode()))
+
+    def keys(self, prefix: str = "") -> List[str]:
+        if self._h is None:
+            with self._mu:
+                return sorted(k for k in self._map if k.startswith(prefix))
+        # the size query and the fill are two calls; the store can mutate in
+        # between, so retry until the fill reports a length that fits the buffer
+        need = self._lib.kml_store_keys(self._h, prefix.encode(), None, 0)
+        for _ in range(8):
+            if need <= 0:
+                return []
+            buf = ctypes.create_string_buffer(int(need))
+            got = self._lib.kml_store_keys(self._h, prefix.encode(), buf, ctypes.c_int64(need))
+            if got <= need:  # stable or shrunk: buffer holds the whole joined list
+                return buf.raw[: max(got, 0)].decode().split("\n") if got > 0 else []
+            need = got  # grew concurrently: retry with the larger size
+        raise RuntimeError("tensorstore keys() kept changing size; giving up")
+
+    def count(self) -> int:
+        if self._h is None:
+            with self._mu:
+                return len(self._map)
+        return int(self._lib.kml_store_count(self._h))
+
+    def nbytes(self) -> int:
+        if self._h is None:
+            with self._mu:
+                return sum(v.nbytes for v in self._map.values())
+        return int(self._lib.kml_store_bytes(self._h))
+
+
+# ---------------------------------------------------------------------------
+# Socket server / client
+# ---------------------------------------------------------------------------
+
+_OP_SET, _OP_GET, _OP_DEL, _OP_DELP, _OP_KEYS, _OP_COUNT, _OP_PING = range(1, 8)
+
+
+class TensorServer:
+    """Serves a native TensorStore over a unix domain socket (the process-local
+    stand-in for the reference's RedisAI service). Requires the native library —
+    multi-process mode is exactly where the Python fallback would bottleneck."""
+
+    def __init__(self, store: TensorStore, socket_path: str):
+        if not store.native:
+            raise RuntimeError("TensorServer requires the native tensor store")
+        self.store = store
+        self.socket_path = socket_path
+        self._srv = store._lib.kml_server_start(store._h, socket_path.encode())
+        if self._srv < 0:
+            raise RuntimeError(f"failed to start tensor server on {socket_path}")
+
+    def stop(self) -> None:
+        if self._srv is not None and self._srv >= 0:
+            self.store._lib.kml_server_stop(self._srv)
+            self._srv = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class TensorClient:
+    """Blocking client for :class:`TensorServer` (usable from any process)."""
+
+    def __init__(self, socket_path: str, timeout: float = 30.0):
+        self.socket_path = socket_path
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout)
+        self._sock.connect(socket_path)
+        self._mu = threading.Lock()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- wire helpers --
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        while n > 0:
+            b = self._sock.recv(min(n, 1 << 20))
+            if not b:
+                raise ConnectionError("tensor server closed the connection")
+            chunks.append(b)
+            n -= len(b)
+        return b"".join(chunks)
+
+    def _status(self) -> int:
+        return struct.unpack("<q", self._recv_exact(8))[0]
+
+    def _req(self, op: int, key: bytes, payload: bytes = b"") -> None:
+        self._sock.sendall(struct.pack("<BI", op, len(key)) + key + payload)
+
+    # -- ops --
+
+    def ping(self) -> bool:
+        with self._mu:
+            self._req(_OP_PING, b"")
+            return self._status() == 0
+
+    def set(self, key: str, value: np.ndarray) -> None:
+        value = np.ascontiguousarray(value)
+        dt = str(value.dtype).encode()
+        hdr = struct.pack(f"<B{len(dt)}sB", len(dt), dt, value.ndim)
+        hdr += struct.pack(f"<{value.ndim}q", *value.shape) if value.ndim else b""
+        hdr += struct.pack("<Q", value.nbytes)
+        with self._mu:
+            self._req(_OP_SET, key.encode(), hdr + value.tobytes())
+            if self._status() != 0:
+                raise RuntimeError(f"tensor server rejected set({key!r})")
+
+    def get(self, key: str) -> Optional[np.ndarray]:
+        with self._mu:
+            self._req(_OP_GET, key.encode())
+            st = self._status()
+            if st == -1:
+                return None
+            if st != 0:
+                raise RuntimeError(f"tensor server get({key!r}) failed: {st}")
+            dlen = self._recv_exact(1)[0]
+            dtype = np.dtype(self._recv_exact(dlen).decode())
+            ndim = self._recv_exact(1)[0]
+            shape: Tuple[int, ...] = ()
+            if ndim:
+                shape = struct.unpack(f"<{ndim}q", self._recv_exact(8 * ndim))
+            nbytes = struct.unpack("<Q", self._recv_exact(8))[0]
+            data = self._recv_exact(nbytes)
+        return np.frombuffer(data, dtype=dtype).reshape(shape).copy()
+
+    def delete(self, key: str) -> bool:
+        with self._mu:
+            self._req(_OP_DEL, key.encode())
+            return self._status() == 0
+
+    def delete_prefix(self, prefix: str) -> int:
+        with self._mu:
+            self._req(_OP_DELP, prefix.encode())
+            return self._status()
+
+    def keys(self, prefix: str = "") -> List[str]:
+        with self._mu:
+            self._req(_OP_KEYS, prefix.encode())
+            if self._status() != 0:
+                raise RuntimeError("tensor server keys() failed")
+            ln = struct.unpack("<Q", self._recv_exact(8))[0]
+            raw = self._recv_exact(ln).decode() if ln else ""
+        return raw.split("\n") if raw else []
+
+    def count(self) -> int:
+        with self._mu:
+            self._req(_OP_COUNT, b"")
+            return self._status()

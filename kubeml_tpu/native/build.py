@@ -1,0 +1,110 @@
+"""On-demand builder for the native data-plane library.
+
+Compiles ``native/kubeml_native.cpp`` with the system C++ toolchain the first
+time it is needed, caching the shared object under ``native/build/`` keyed by a
+content hash — the equivalent of the reference shipping RedisAI as a prebuilt
+native module, except rebuilt transparently when sources change. Every caller
+must tolerate a missing toolchain: the Python fallbacks in
+:mod:`kubeml_tpu.native.bindings` keep the framework fully functional.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import shutil
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger("kubeml.native")
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+SOURCE = _REPO_ROOT / "native" / "kubeml_native.cpp"
+BUILD_DIR = _REPO_ROOT / "native" / "build"
+
+_lock = threading.Lock()
+_cached: Optional[Path] = None
+_failed = False
+_bg_thread: Optional[threading.Thread] = None
+
+
+def _compiler() -> Optional[str]:
+    for cc in (os.environ.get("CXX"), "g++", "clang++", "c++"):
+        if cc and shutil.which(cc):
+            return cc
+    return None
+
+
+def _build_locked(compile: bool = True) -> Optional[Path]:
+    """Find the cached .so (and compile it when ``compile``). Caller holds ``_lock``."""
+    global _cached, _failed
+    if _cached is not None:
+        return _cached
+    if _failed or os.environ.get("KUBEML_NO_NATIVE"):
+        return None
+    if not SOURCE.exists():
+        _failed = True
+        return None
+    digest = hashlib.sha256(SOURCE.read_bytes()).hexdigest()[:16]
+    out = BUILD_DIR / f"libkubeml_native-{digest}.so"
+    if out.exists():
+        _cached = out
+        return out
+    if not compile:
+        return None
+    cc = _compiler()
+    if cc is None:
+        log.warning("no C++ compiler found; native data-plane disabled")
+        _failed = True
+        return None
+    BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    tmp = out.with_suffix(f".tmp{os.getpid()}")
+    cmd = [
+        cc, "-O3", "-std=c++17", "-fPIC", "-pthread", "-shared",
+        "-o", str(tmp), str(SOURCE),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        os.replace(tmp, out)
+    except (subprocess.SubprocessError, OSError) as e:
+        stderr = getattr(e, "stderr", b"") or b""
+        log.warning("native build failed (%s): %s", e, stderr.decode(errors="replace")[-2000:])
+        tmp.unlink(missing_ok=True)
+        _failed = True
+        return None
+    _cached = out
+    return out
+
+
+def library_path(block: bool = True) -> Optional[Path]:
+    """Path to the built .so, compiling if necessary; None when unavailable.
+
+    ``block=False`` never compiles on the calling thread: it returns the cached
+    path if the build already happened, otherwise kicks the compile off on a
+    background thread and returns None — the data path keeps feeding through
+    the numpy fallback instead of stalling the first training round behind g++.
+    """
+    global _bg_thread
+    if block:
+        with _lock:
+            return _build_locked()
+    # non-blocking: cheap resolve of an already-built .so, then fire-and-forget
+    # background compile
+    if not _lock.acquire(blocking=False):
+        return None  # a build is in flight
+    try:
+        found = _build_locked(compile=False)
+        if found is not None or _failed:
+            return found
+        if _bg_thread is None or not _bg_thread.is_alive():
+            _bg_thread = threading.Thread(
+                target=lambda: library_path(block=True), name="kml-native-build",
+                daemon=True,
+            )
+            _bg_thread.start()
+        return None
+    finally:
+        _lock.release()
