@@ -96,7 +96,11 @@ class GPTBlock(nn.Module):
 
 
 class CausalTransformer(nn.Module):
-    """Decoder-only LM over int32 token ids [B, L]; id 0 = padding."""
+    """Decoder-only LM over int32 token ids [B, L]; id 0 = padding.
+
+    ``moe_every > 0`` replaces every ``moe_every``-th block's MLP with routed
+    experts (kubeml_tpu.parallel.moe, sharded over the ``ep`` mesh axis),
+    GShard-style interleaving; 0 (default) is the dense model."""
 
     vocab_size: int = 32000
     max_len: int = 2048
@@ -106,6 +110,10 @@ class CausalTransformer(nn.Module):
     mlp_ratio: int = 4
     dropout: float = 0.0
     mesh: Optional[Mesh] = None
+    # --- MoE interleaving ---
+    moe_every: int = 0
+    num_experts: int = 8
+    top_k: int = 2
 
     @nn.compact
     def __call__(self, token_ids, train: bool = False):
@@ -119,8 +127,15 @@ class CausalTransformer(nn.Module):
                          (1, self.max_len, self.embed_dim))
         x = x + pos[:, :L]
         for i in range(self.depth):
-            x = GPTBlock(self.num_heads, self.mlp_ratio, self.dropout,
-                         mesh=self.mesh, name=f"block_{i}")(x, valid, train=train)
+            if self.moe_every > 0 and (i + 1) % self.moe_every == 0:
+                from ..parallel.moe import MoEBlock
+
+                x = MoEBlock(self.num_heads, self.num_experts, self.mlp_ratio,
+                             self.top_k, self.dropout, mesh=self.mesh,
+                             name=f"block_{i}")(x, valid, train=train)
+            else:
+                x = GPTBlock(self.num_heads, self.mlp_ratio, self.dropout,
+                             mesh=self.mesh, name=f"block_{i}")(x, valid, train=train)
         x = nn.LayerNorm(name="ln_f")(x)
         logits = nn.Dense(self.vocab_size, name="lm_head", use_bias=False,
                           kernel_init=_part((None, "tp"))(nn.initializers.lecun_normal()))(x)

@@ -16,6 +16,19 @@ the schedule matters (ring attention, a2a expert dispatch).
 """
 
 from .mesh import make_mesh, mesh_shape_for
+from .moe import MoEBlock, MoEMlp, MoETiny, MoETransformer
+from .pipeline import PipelinedLM, PipelineTrainer, gpipe
 from .ring import ring_attention
 
-__all__ = ["make_mesh", "mesh_shape_for", "ring_attention"]
+__all__ = [
+    "MoEBlock",
+    "MoEMlp",
+    "MoETiny",
+    "MoETransformer",
+    "PipelinedLM",
+    "PipelineTrainer",
+    "gpipe",
+    "make_mesh",
+    "mesh_shape_for",
+    "ring_attention",
+]

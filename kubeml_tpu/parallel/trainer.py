@@ -111,8 +111,16 @@ class SPMDTrainer:
         def step(variables, opt_state, batch, rng):
             def compute_loss(params):
                 vs = {**variables, "params": params}
-                logits = module.apply(vs, cast(batch), train=True, rngs={"dropout": rng})
-                return loss_fn(logits.astype(jnp.float32), batch)
+                # mutable aux_loss collects router load-balancing penalties sown
+                # by MoE layers (kubeml_tpu.parallel.moe); empty otherwise
+                logits, sown = module.apply(
+                    vs, cast(batch), train=True, rngs={"dropout": rng},
+                    mutable=["aux_loss"],
+                )
+                loss = loss_fn(logits.astype(jnp.float32), batch)
+                for leaf in jax.tree.leaves(sown.get("aux_loss", {})):
+                    loss = loss + jnp.sum(leaf)
+                return loss
 
             loss, grads = jax.value_and_grad(compute_loss)(variables["params"])
             updates, opt_next = tx.update(grads, opt_state, variables["params"])

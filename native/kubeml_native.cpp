@@ -91,6 +91,9 @@ std::shared_ptr<Store> find_store(int64_t h) {
 constexpr int32_t kMaxNdim = 8;
 constexpr uint32_t kMaxKeyLen = 4096;
 constexpr uint8_t kMaxDtypeLen = 16;
+// per-tensor ceiling for the socket server: a desynced or hostile client must
+// not be able to drive resize() into bad_alloc (8 GiB covers any real layer)
+constexpr uint64_t kMaxTensorBytes = 8ull << 30;
 
 // ---------------------------------------------------------------------------
 // 3. Unix-socket server (RedisAI stand-in for multi-process deployments)
@@ -142,7 +145,7 @@ bool send_status(int fd, int64_t status) {
   return write_exact(fd, &status, sizeof(status));
 }
 
-void handle_conn(std::shared_ptr<Store> store, int fd) {
+void handle_conn_inner(std::shared_ptr<Store> store, int fd) {
   for (;;) {
     uint8_t op;
     if (!read_exact(fd, &op, 1)) break;
@@ -173,6 +176,10 @@ void handle_conn(std::shared_ptr<Store> store, int fd) {
       if (ndim && !read_exact(fd, shape.data(), ndim * sizeof(int64_t))) break;
       uint64_t nbytes;
       if (!read_exact(fd, &nbytes, 8)) break;
+      if (nbytes > kMaxTensorBytes) {
+        send_status(fd, -2);
+        break;  // stream is desynced past repair; drop the connection
+      }
       Tensor t;
       t.dtype = std::move(dtype);
       t.shape = std::move(shape);
@@ -188,25 +195,33 @@ void handle_conn(std::shared_ptr<Store> store, int fd) {
       }
       if (!send_status(fd, 0)) break;
     } else if (op == 2) {  // GET
-      std::shared_lock<std::shared_mutex> lk(store->mu);
-      auto it = store->items.find(key);
-      if (it == store->items.end()) {
-        lk.unlock();
+      // copy out under the read lock, write to the socket after releasing it:
+      // a slow client must never block writers (SET takes the unique lock)
+      Tensor copy;
+      bool found = false;
+      {
+        std::shared_lock<std::shared_mutex> lk(store->mu);
+        auto it = store->items.find(key);
+        if (it != store->items.end()) {
+          copy = it->second;
+          found = true;
+        }
+      }
+      if (!found) {
         if (!send_status(fd, -1)) break;
         continue;
       }
-      const Tensor& t = it->second;
       if (!send_status(fd, 0)) break;
-      uint8_t dlen = static_cast<uint8_t>(t.dtype.size());
-      uint8_t ndim = static_cast<uint8_t>(t.shape.size());
-      uint64_t nbytes = t.data.size();
+      uint8_t dlen = static_cast<uint8_t>(copy.dtype.size());
+      uint8_t ndim = static_cast<uint8_t>(copy.shape.size());
+      uint64_t nbytes = copy.data.size();
       bool ok = write_exact(fd, &dlen, 1) &&
-                write_exact(fd, t.dtype.data(), dlen) &&
+                write_exact(fd, copy.dtype.data(), dlen) &&
                 write_exact(fd, &ndim, 1) &&
                 (ndim == 0 ||
-                 write_exact(fd, t.shape.data(), ndim * sizeof(int64_t))) &&
+                 write_exact(fd, copy.shape.data(), ndim * sizeof(int64_t))) &&
                 write_exact(fd, &nbytes, 8) &&
-                (nbytes == 0 || write_exact(fd, t.data.data(), nbytes));
+                (nbytes == 0 || write_exact(fd, copy.data.data(), nbytes));
       if (!ok) break;
     } else if (op == 3) {  // DEL
       std::unique_lock<std::shared_mutex> lk(store->mu);
@@ -261,6 +276,16 @@ void handle_conn(std::shared_ptr<Store> store, int fd) {
     }
   }
   ::close(fd);
+}
+
+void handle_conn(std::shared_ptr<Store> store, int fd) {
+  // detached thread: an escaping exception (e.g. bad_alloc on a huge SET)
+  // would std::terminate the whole process — contain it to this connection
+  try {
+    handle_conn_inner(std::move(store), fd);
+  } catch (...) {
+    ::close(fd);
+  }
 }
 
 struct Server {
