@@ -101,12 +101,16 @@ class TrainOptions(_JsonMixin):
     checkpoint_every: int = 0  # save a checkpoint every N epochs; 0 = off
     resume: bool = False  # restore the latest checkpoint for this job id and continue
     save_model: bool = True  # export the final model at job end (enables later infer)
+    # --- fault injection (chaos testing; the reference only mentions chaos-monkey) ---
+    chaos_prob: float = 0.0  # per-worker per-round failure probability
 
     def __post_init__(self):
         if self.validate_every < 0:
             raise ValueError("validate_every must be >= 0")
         if self.checkpoint_every < 0:
             raise ValueError("checkpoint_every must be >= 0")
+        if not (0.0 <= self.chaos_prob <= 1.0):
+            raise ValueError("chaos_prob must be in [0, 1]")
         if self.k == 0 or self.k < -1:
             raise ValueError("k must be -1 (sparse) or a positive step count")
         if self.mesh_shape is not None:

@@ -62,6 +62,7 @@ def cmd_train(args) -> int:
             checkpoint_every=args.checkpoint_every,
             resume=args.resume,
             save_model=not args.no_save_model,
+            chaos_prob=args.chaos_prob,
         ),
     )
     job_id = _client(args).networks().train(req)
@@ -254,6 +255,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="resume from --id's latest checkpoint")
     t.add_argument("--no-save-model", action="store_true",
                    help="skip the final model export")
+    t.add_argument("--chaos-prob", type=float, default=0.0,
+                   help="per-worker per-round failure injection probability")
     t.set_defaults(fn=cmd_train)
 
     i = sub.add_parser("infer", help="run inference against a trained job")

@@ -26,7 +26,7 @@ from typing import Callable, Optional
 import jax
 import numpy as np
 
-from ..api.errors import KubeMLError
+from ..api.errors import KubeMLError, MergeError
 from ..api.types import History, JobState, MetricUpdate, TrainRequest
 from ..data.dataset import KubeDataset
 from ..data.loader import RoundLoader, validation_loader
@@ -35,6 +35,8 @@ from ..runtime.model import KubeModel
 from ..storage.checkpoint import FINAL_TAG, CheckpointStore
 from ..storage.history import HistoryStore
 from ..storage.store import ShardStore
+from ..utils.tracing import get_tracer
+from .failures import FailureInjector, WorkerHealth
 from .kavg import KAvgTrainer
 
 log = logging.getLogger("kubeml.job")
@@ -53,6 +55,8 @@ class TrainJob:
         on_metrics: Optional[Callable[[MetricUpdate], None]] = None,
         devices=None,
         seed: int = 0,
+        chaos: Optional[FailureInjector] = None,
+        health_threshold: int = 3,
     ):
         self.job_id = job_id
         self.request = request
@@ -69,6 +73,13 @@ class TrainJob:
             model, precision=request.options.precision, devices=devices,
             donate=request.options.donate, mesh_shape=request.options.mesh_shape,
         )
+        # fault injection + health-based re-meshing (SURVEY §5/§7)
+        if chaos is None and request.options.chaos_prob > 0.0:
+            chaos = FailureInjector(prob=request.options.chaos_prob, seed=seed)
+        self.chaos = chaos
+        self.health = WorkerHealth(threshold=health_threshold)
+        self.tracer = get_tracer()
+
         self.history = History(id=job_id, task={"request": request.to_dict()})
         self.stop_event = threading.Event()
         self.exit_error: Optional[str] = None
@@ -126,10 +137,29 @@ class TrainJob:
                     break
                 t0 = time.time()
                 used_parallelism = self.parallelism
-                train_loss = self._train_epoch(epoch, handle, dataset)
+                with self.tracer.span("job.epoch", job=self.job_id, epoch=epoch,
+                                      parallelism=self.parallelism):
+                    train_loss = self._train_epoch(epoch, handle, dataset)
                 elapsed = time.time() - t0
                 if self.stop_event.is_set() and np.isnan(train_loss):
                     break  # stopped mid-epoch before any round completed
+
+                # health-based re-mesh (SURVEY §7 "partial failure inside
+                # collectives"): persistently dead workers shrink the mesh at
+                # the epoch boundary — the collective can't drop them mid-round
+                if not opts.static_parallelism:
+                    healthy_p = self.health.suggest_parallelism(self.parallelism)
+                    if healthy_p < self.parallelism:
+                        log.warning(
+                            "%s: %d persistently failed worker(s); re-meshing %d -> %d",
+                            self.job_id, self.parallelism - healthy_p,
+                            self.parallelism, healthy_p,
+                        )
+                        self._stacked_vars = self.trainer.resize(
+                            self._stacked_vars, self.parallelism, healthy_p
+                        )
+                        self.parallelism = healthy_p
+                        self.health.reset()  # indices renumber after the re-mesh
 
                 # elastic re-evaluation (job.go:196-215): ask the scheduler with
                 # this epoch's elapsed time unless parallelism is static
@@ -145,6 +175,9 @@ class TrainJob:
                             self._stacked_vars, self.parallelism, new_p
                         )
                         self.parallelism = new_p
+                        # worker indices renumber on any resize: stale
+                        # consecutive-failure counts must not transfer
+                        self.health.reset()
 
                 # periodic validation (job.go:223-243)
                 val_loss = None
@@ -240,29 +273,56 @@ class TrainJob:
         for rb in loader:
             if self.stop_event.is_set():
                 break
-            self._stacked_vars, loss = self.trainer.sync_round(
-                self._stacked_vars,
-                rb.x,
-                rb.y,
-                rb.mask,
-                jax.random.fold_in(rng, rb.round_index),
-                lr=req.lr,
-                epoch=epoch,
-            )
+            worker_mask = None
+            if self.chaos is not None:
+                worker_mask = self.chaos.mask(self.parallelism)
+                newly_dead = self.health.update(worker_mask)
+                if worker_mask.min() == 0.0:
+                    log.info("%s: round %d injected failures on workers %s",
+                             self.job_id, rb.round_index,
+                             np.flatnonzero(worker_mask == 0.0).tolist())
+                for w in newly_dead:
+                    log.warning("%s: worker %d persistently failed", self.job_id, w)
+            with self.tracer.span("job.round", job=self.job_id, epoch=epoch,
+                                  round=rb.round_index):
+                self._stacked_vars, loss = self.trainer.sync_round(
+                    self._stacked_vars,
+                    rb.x,
+                    rb.y,
+                    rb.mask,
+                    jax.random.fold_in(rng, rb.round_index),
+                    lr=req.lr,
+                    epoch=epoch,
+                    worker_mask=worker_mask,
+                )
             losses.append(loss)
         if not losses:
             if self.stop_event.is_set():
                 return float("nan")  # graceful stop before any round completed
             raise KubeMLError(f"job {self.job_id}: epoch produced no rounds")
-        # one blocking host read per epoch, not per round (keeps rounds async)
-        return float(np.mean([float(l) for l in losses]))
+        # one blocking host read per epoch, not per round (keeps rounds async).
+        # NaN losses mark rounds skipped for zero effective participants (the
+        # engine kept the pre-round weights); an epoch of only skipped rounds
+        # made no progress at all — that is an error, like zero responders
+        vals = np.array([float(l) for l in losses])
+        finite = vals[np.isfinite(vals)]
+        if len(finite) == 0:
+            raise MergeError(
+                f"job {self.job_id}: no round in this epoch had a healthy "
+                f"data-bearing worker"
+            )
+        if len(finite) < len(vals):
+            log.warning("%s: %d/%d rounds skipped (no effective participants)",
+                        self.job_id, len(vals) - len(finite), len(vals))
+        return float(finite.mean())
 
     def _validate(self, dataset: KubeDataset, handle):
         dataset.set_mode(False)
         loader = validation_loader(
             handle, self.parallelism, self.request.batch_size, transform=dataset.transform
         )
-        acc, loss = self.trainer.evaluate_rounds(self._stacked_vars, loader)
+        with self.tracer.span("job.validate", job=self.job_id):
+            acc, loss = self.trainer.evaluate_rounds(self._stacked_vars, loader)
         dataset.set_mode(True)
         return acc, loss
 
@@ -278,12 +338,14 @@ class TrainJob:
 
     def _save_checkpoint(self, epoch: int) -> None:
         try:
-            self.checkpoint_store.save(
-                self.job_id,
-                self.trainer.reference_variables(self._stacked_vars),
-                epoch=epoch,
-                meta={"request": self.request.to_dict(), "history": self._history_lists()},
-            )
+            with self.tracer.span("job.checkpoint", job=self.job_id, epoch=epoch):
+                self.checkpoint_store.save(
+                    self.job_id,
+                    self.trainer.reference_variables(self._stacked_vars),
+                    epoch=epoch,
+                    meta={"request": self.request.to_dict(),
+                          "history": self._history_lists()},
+                )
         except Exception:
             log.exception("%s: checkpoint save failed (non-fatal)", self.job_id)
 

@@ -209,11 +209,27 @@ class KAvgTrainer:
         def sync_round(stacked_vars, x, y, mask, worker_mask, rng):
             x = self._cast_input(x)
             rngs = jax.random.split(rng, n_workers)
+            # pre-round reference: replicas are identical at round start (post
+            # previous sync / init broadcast) — the fallback when no worker is
+            # both healthy AND data-bearing this round
+            before = jax.tree.map(lambda v: v[0], stacked_vars)
             vars_n, losses, active = jax.vmap(per_worker)(stacked_vars, x, y, mask, rngs)
             weights = worker_mask * active
+            has_any = weights.sum() > 0
             avg = _mean_over_workers(vars_n, weights)
-            # simple mean of participating workers' losses (train/util.go:82-95)
-            mean_loss = (losses * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+            # zero effective participants (e.g. chaos killed every data-bearing
+            # worker while a fully-padded one stayed 'healthy') must keep the
+            # pre-round weights, never average an empty set into zeros
+            avg = jax.tree.map(
+                lambda a, b: jnp.where(has_any, a, b), avg, before
+            )
+            # simple mean of participating workers' losses (train/util.go:82-95);
+            # NaN marks a skipped round for the host to filter
+            mean_loss = jnp.where(
+                has_any,
+                (losses * weights).sum() / jnp.maximum(weights.sum(), 1.0),
+                jnp.nan,
+            )
             return _broadcast_to_workers(avg, n_workers), mean_loss
 
         sharded, replicated = self._shardings(n_workers)

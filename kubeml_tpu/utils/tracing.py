@@ -1,0 +1,195 @@
+"""Span tracing + device profiling.
+
+The reference has no tracing or profiling at all — only zap log lines with
+ad-hoc timings (SURVEY §5: merge time ml/pkg/train/job.go:397-412, epoch
+ElapsedTime job.go:321-322). This subsystem is the TPU-native upgrade:
+
+* :class:`Tracer` — thread-safe in-memory span recorder with ~zero overhead
+  when disabled; spans nest via a context manager and carry attributes
+  (job id, epoch, round, parallelism...). Export as Chrome trace-event JSON
+  (load in chrome://tracing / Perfetto) or per-name summary statistics.
+* :func:`device_profile` — wraps ``jax.profiler.trace`` so a job (or bench run)
+  can capture a TensorBoard/XProf device trace of the XLA execution itself.
+
+The process-wide tracer is enabled with ``KUBEML_TRACE=<dir>`` (spans are
+flushed to ``<dir>/kubeml-trace-<pid>.json`` at exit or on ``flush()``), or
+programmatically via ``get_tracer().enable(...)``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import logging
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional
+
+log = logging.getLogger("kubeml.trace")
+
+MAX_SPANS = 200_000  # hard cap: a runaway loop must not eat the host's RAM
+
+
+@dataclass
+class Span:
+    name: str
+    start: float  # time.time() seconds
+    duration: float  # seconds
+    thread: int
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+
+class Tracer:
+    """Span recorder. Disabled by default: ``span()`` costs one attribute read."""
+
+    def __init__(self, enabled: bool = False, out_dir: Optional[Path] = None):
+        self.enabled = enabled
+        self.out_dir = Path(out_dir) if out_dir else None
+        self._spans: List[Span] = []
+        self._lock = threading.Lock()
+        self._dropped = 0
+
+    # --- control ---
+
+    def enable(self, out_dir: Optional[Path] = None) -> "Tracer":
+        self.enabled = True
+        if out_dir is not None:
+            self.out_dir = Path(out_dir)
+        return self
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._dropped = 0
+
+    # --- recording ---
+
+    def _append(self, s: Span) -> None:
+        with self._lock:
+            if len(self._spans) < MAX_SPANS:
+                self._spans.append(s)
+            else:
+                self._dropped += 1
+
+    @contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[Optional[Span]]:
+        if not self.enabled:
+            yield None
+            return
+        t0 = time.time()
+        s = Span(name=name, start=t0, duration=0.0,
+                 thread=threading.get_ident(), attrs=attrs)
+        try:
+            yield s
+        finally:
+            s.duration = time.time() - t0
+            self._append(s)
+
+    def record(self, name: str, duration: float, **attrs: Any) -> None:
+        """Record an externally-timed span (e.g. a device-side duration)."""
+        if not self.enabled:
+            return
+        self._append(Span(name=name, start=time.time() - duration, duration=duration,
+                          thread=threading.get_ident(), attrs=attrs))
+
+    # --- reading ---
+
+    def spans(self, name: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            out = list(self._spans)
+        if name is not None:
+            out = [s for s in out if s.name == name]
+        return out
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-name {count, total_s, mean_s, max_s}."""
+        agg: Dict[str, List[float]] = {}
+        for s in self.spans():
+            agg.setdefault(s.name, []).append(s.duration)
+        return {
+            name: {
+                "count": len(ds),
+                "total_s": sum(ds),
+                "mean_s": sum(ds) / len(ds),
+                "max_s": max(ds),
+            }
+            for name, ds in sorted(agg.items())
+        }
+
+    # --- export ---
+
+    def to_chrome_trace(self) -> List[Dict[str, Any]]:
+        """Chrome trace-event format ('X' complete events, µs timestamps)."""
+        return [
+            {
+                "name": s.name,
+                "ph": "X",
+                "ts": s.start * 1e6,
+                "dur": s.duration * 1e6,
+                "pid": os.getpid(),
+                "tid": s.thread % (1 << 31),
+                "args": {k: _json_safe(v) for k, v in s.attrs.items()},
+            }
+            for s in self.spans()
+        ]
+
+    def flush(self, path: Optional[Path] = None) -> Optional[Path]:
+        """Write the Chrome trace JSON; returns the path (None if nothing to do)."""
+        if path is None:
+            if self.out_dir is None:
+                return None
+            path = self.out_dir / f"kubeml-trace-{os.getpid()}.json"
+        events = self.to_chrome_trace()
+        if not events:
+            return None
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps({"traceEvents": events}))
+        if self._dropped:
+            log.warning("trace dropped %d spans past the %d cap", self._dropped, MAX_SPANS)
+        return path
+
+
+def _json_safe(v: Any) -> Any:
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+# --- process-wide tracer ---
+
+_global = Tracer()
+_atexit_armed = False
+
+
+def get_tracer() -> Tracer:
+    global _atexit_armed
+    env_dir = os.environ.get("KUBEML_TRACE")
+    if env_dir and not _global.enabled:
+        _global.enable(Path(env_dir))
+        if not _atexit_armed:
+            _atexit_armed = True
+            atexit.register(_global.flush)
+    return _global
+
+
+# --- device (XLA) profiling ---
+
+
+@contextmanager
+def device_profile(log_dir: Path) -> Iterator[None]:
+    """Capture a TensorBoard/XProf device trace of everything inside the block
+    (compile + execute on the attached TPU/CPU backend)."""
+    import jax
+
+    log_dir = Path(log_dir)
+    log_dir.mkdir(parents=True, exist_ok=True)
+    with jax.profiler.trace(str(log_dir)):
+        yield

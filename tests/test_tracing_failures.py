@@ -1,0 +1,266 @@
+"""Tracing + failure-injection subsystem tests (SURVEY §5: the reference has
+neither tracing nor chaos; partial-failure semantics mirror util.go:144-166)."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from kubeml_tpu.api.errors import MergeError
+from kubeml_tpu.engine.failures import FailureInjector, WorkerHealth
+from kubeml_tpu.utils.tracing import Tracer
+
+from test_job import KubeLeNet, _request, mnist_store  # noqa: F401
+
+
+# --- Tracer ---
+
+
+def test_tracer_disabled_records_nothing():
+    t = Tracer()
+    with t.span("x"):
+        pass
+    assert t.spans() == []
+
+
+def test_tracer_spans_and_summary():
+    t = Tracer(enabled=True)
+    with t.span("outer", job="j1"):
+        with t.span("inner"):
+            pass
+        with t.span("inner"):
+            pass
+    assert len(t.spans()) == 3
+    assert len(t.spans("inner")) == 2
+    s = t.summary()
+    assert s["inner"]["count"] == 2
+    assert s["outer"]["count"] == 1
+    assert s["outer"]["max_s"] >= s["inner"]["max_s"]
+    assert t.spans("outer")[0].attrs == {"job": "j1"}
+
+
+def test_tracer_record_external_duration():
+    t = Tracer(enabled=True)
+    t.record("device_step", 0.25, round=3)
+    (s,) = t.spans()
+    assert s.duration == 0.25 and s.attrs["round"] == 3
+
+
+def test_tracer_chrome_export_and_flush(tmp_path):
+    t = Tracer(enabled=True)
+    with t.span("epoch", epoch=0):
+        pass
+    path = t.flush(tmp_path / "trace.json")
+    data = json.loads(path.read_text())
+    (ev,) = data["traceEvents"]
+    assert ev["name"] == "epoch" and ev["ph"] == "X"
+    assert ev["dur"] >= 0 and ev["args"] == {"epoch": 0}
+
+
+def test_tracer_thread_safety():
+    t = Tracer(enabled=True)
+
+    def worker():
+        for _ in range(200):
+            with t.span("w"):
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    [x.start() for x in threads]
+    [x.join() for x in threads]
+    assert len(t.spans()) == 1600
+
+
+# --- FailureInjector ---
+
+
+def test_injector_schedule_and_determinism():
+    a = FailureInjector(schedule={1: [0, 2]}, seed=7)
+    b = FailureInjector(schedule={1: [0, 2]}, seed=7)
+    for _ in range(3):
+        np.testing.assert_array_equal(a.mask(4), b.mask(4))
+    c = FailureInjector(schedule={1: [0, 2]})
+    assert c.mask(4).tolist() == [1, 1, 1, 1]
+    assert c.mask(4).tolist() == [0, 1, 0, 1]  # round 1: workers 0 and 2 down
+    assert c.mask(4).tolist() == [1, 1, 1, 1]
+
+
+def test_injector_keep_one_alive():
+    inj = FailureInjector(prob=1.0, seed=0)
+    for _ in range(10):
+        m = inj.mask(4)
+        assert m.sum() == 1.0  # everything fails except the guaranteed survivor
+
+
+def test_injector_total_failure_allowed_when_disabled():
+    inj = FailureInjector(prob=1.0, keep_one_alive=False)
+    assert inj.mask(4).sum() == 0.0
+
+
+# --- WorkerHealth ---
+
+
+def test_health_threshold_and_recovery():
+    h = WorkerHealth(threshold=2)
+    assert h.update(np.array([1, 0, 1])) == []
+    assert h.update(np.array([1, 0, 1])) == [1]  # second consecutive failure
+    assert h.update(np.array([1, 0, 1])) == []  # already reported
+    assert h.persistent == {1}
+    assert h.suggest_parallelism(3) == 2
+    h.update(np.array([1, 1, 1]))  # worker 1 recovers
+    assert h.persistent == set()
+    assert h.suggest_parallelism(3) == 3
+
+
+def test_health_multiple_dead():
+    h = WorkerHealth(threshold=1)
+    h.update(np.array([0, 0, 1, 1]))
+    assert h.suggest_parallelism(4) == 2
+    assert h.suggest_parallelism(1) == 1  # floor
+
+
+# --- TrainJob integration ---
+
+
+def _chaos_job(job_id, req, store, cfg, chaos, **kw):
+    from kubeml_tpu.engine.job import TrainJob
+    from kubeml_tpu.storage import CheckpointStore, HistoryStore
+
+    return TrainJob(
+        job_id, req, KubeLeNet(), store=store,
+        history_store=HistoryStore(config=cfg),
+        checkpoint_store=CheckpointStore(config=cfg), chaos=chaos, **kw,
+    )
+
+
+def test_job_survives_injected_failures(mnist_store, tmp_config):
+    """Rounds with failed workers average over the survivors (util.go:144-166)."""
+    req = _request(epochs=2, options={"default_parallelism": 4,
+                                      "static_parallelism": True, "k": 2})
+    chaos = FailureInjector(prob=0.3, seed=3)
+    job = _chaos_job("chaos1", req, mnist_store, tmp_config, chaos)
+    hist = job.train()
+    assert len(hist.train_loss) == 2
+    assert all(np.isfinite(l) for l in hist.train_loss)
+
+
+def test_job_total_failure_round_errors(mnist_store, tmp_config):
+    """Zero healthy workers in a round is a hard MergeError (job.go:388-391)."""
+    from kubeml_tpu.api.errors import KubeMLError
+
+    req = _request(epochs=1, options={"default_parallelism": 2,
+                                      "static_parallelism": True, "k": 2})
+    chaos = FailureInjector(prob=1.0, keep_one_alive=False)
+    job = _chaos_job("chaos2", req, mnist_store, tmp_config, chaos)
+    with pytest.raises((MergeError, KubeMLError)):
+        job.train()
+
+
+def test_job_health_shrinks_parallelism(mnist_store, tmp_config):
+    """A persistently dead worker shrinks the mesh at the epoch boundary."""
+    # worker 3 fails every round from the start
+    schedule = {r: [3] for r in range(200)}
+    chaos = FailureInjector(schedule=schedule)
+    req = _request(epochs=3, options={"default_parallelism": 4,
+                                      "static_parallelism": False, "k": 2})
+    job = _chaos_job("chaos3", req, mnist_store, tmp_config, chaos,
+                     health_threshold=2)
+    hist = job.train()
+    assert hist.parallelism[0] == 4
+    assert hist.parallelism[-1] == 3, f"no health re-mesh: {hist.parallelism}"
+    assert all(np.isfinite(l) for l in hist.train_loss)
+
+
+def test_round_with_no_effective_participants_keeps_weights(tmp_config, rng):
+    """If every data-bearing worker is masked but a fully-padded worker stays
+    'healthy', the round must keep the pre-round weights — never average an
+    empty set into zeros (and the loss reads NaN so the host can filter it)."""
+    import jax
+    import optax
+
+    from kubeml_tpu.engine.kavg import KAvgTrainer
+    from kubeml_tpu.runtime.model import KubeModel
+    from kubeml_tpu.data.dataset import KubeDataset
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+    class Ds(KubeDataset):
+        def __init__(self):
+            super().__init__("unused")
+
+    class M(KubeModel):
+        def __init__(self):
+            super().__init__(Ds())
+
+        def build(self):
+            return Tiny()
+
+        def configure_optimizers(self):
+            return optax.sgd(0.1)
+
+    trainer = KAvgTrainer(M(), precision="f32")
+    n, k, b = 2, 1, 4
+    x = rng.normal(size=(n, k, b, 3)).astype(np.float32)
+    y = rng.integers(0, 4, size=(n, k, b)).astype(np.int64)
+    mask = np.zeros((n, k, b), np.float32)
+    mask[0] = 1.0  # worker 0 has data, worker 1 is fully padded
+    variables = trainer.init_variables(jax.random.PRNGKey(0), x[0, 0], n)
+    before = trainer.reference_variables(variables)
+    # chaos kills worker 0 (the only data-bearing one); worker 1 stays healthy
+    worker_mask = np.array([0.0, 1.0], np.float32)
+    out_vars, loss = trainer.sync_round(
+        variables, x, y, mask, jax.random.PRNGKey(1), lr=0.1,
+        worker_mask=worker_mask,
+    )
+    assert np.isnan(float(loss))  # skipped-round marker
+    after = trainer.reference_variables(out_vars)
+    for a, b_ in zip(jax.tree.leaves(after), jax.tree.leaves(before)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+
+def test_job_chaos_prob_option_via_request(mnist_store, tmp_config):
+    """TrainOptions.chaos_prob wires the injector without constructing one."""
+    req = _request(epochs=1, options={"default_parallelism": 2,
+                                      "static_parallelism": True, "k": 2,
+                                      "chaos_prob": 0.5})
+    job = _chaos_job("chaos4", req, mnist_store, tmp_config, chaos=None)
+    assert job.chaos is not None
+    hist = job.train()
+    assert np.isfinite(hist.train_loss[0])
+
+
+def test_job_emits_trace_spans(mnist_store, tmp_config, tmp_path):
+    from kubeml_tpu.utils import tracing
+
+    tracer = tracing.get_tracer()
+    tracer.clear()
+    tracer.enable(tmp_path)
+    try:
+        req = _request(epochs=1, options={"default_parallelism": 2,
+                                          "static_parallelism": True, "k": 2})
+        job = _chaos_job("traced", req, mnist_store, tmp_config, chaos=None)
+        job.train()
+        names = {s.name for s in tracer.spans()}
+        assert {"job.epoch", "job.round", "job.validate"} <= names
+        path = tracer.flush()
+        data = json.loads(path.read_text())
+        assert len(data["traceEvents"]) == len(tracer.spans())
+    finally:
+        tracer.disable()
+        tracer.clear()
+
+
+def test_device_profile_writes_trace(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from kubeml_tpu.utils.tracing import device_profile
+
+    with device_profile(tmp_path / "prof"):
+        jax.block_until_ready(jax.jit(lambda x: x * 2)(jnp.ones(8)))
+    assert any((tmp_path / "prof").rglob("*"))  # xprof/tensorboard artifacts
