@@ -1,3 +1,11 @@
 from .harness import flagship, make_synthetic_model
+from .scenarios import ExperimentDriver, ScenarioResult, run_all, scenarios
 
-__all__ = ["flagship", "make_synthetic_model"]
+__all__ = [
+    "ExperimentDriver",
+    "ScenarioResult",
+    "flagship",
+    "make_synthetic_model",
+    "run_all",
+    "scenarios",
+]

@@ -1,0 +1,397 @@
+"""The BASELINE.md benchmark scenario suite — the port of the reference's
+experiment harness (reference: ml/experiments/common/experiment.py:82-182
+``KubemlExperiment``: run task -> poll ``task list --short`` -> fetch
+``history get`` -> persist records).
+
+Five scenarios mirror BASELINE.md's rebuild targets:
+
+1. ``lenet-mnist``      — single worker, goal-accuracy semantics
+2. ``resnet18-cifar10`` — data-parallel K-AVG, K=8 (the headline config)
+3. ``vit-cifar100``     — transforms pipeline end-to-end
+4. ``bert-sst2``        — text shards (token ids) fine-tune shape
+5. ``elastic-multijob`` — concurrent ResNet + LeNet on one cluster; records
+   both parallelism traces (scheduler scale in/out)
+
+Each scenario drives the REAL stack: datasets through the ShardStore, function
+source through the registry, the job through scheduler -> PS -> TrainJob, and
+results from the history store — the same path a user's CLI request takes.
+``quick=True`` shrinks data/epochs for CI; full mode is the bench
+configuration. Run: ``python -m kubeml_tpu.benchmarks.scenarios --quick``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api.config import Config
+from ..api.types import TrainOptions, TrainRequest
+
+# --- synthetic datasets shaped like the reference's benchmarks ---
+
+
+def synth_images(n: int, shape: Tuple[int, ...], classes: int, seed: int):
+    """Learnable image task: class = brightest of ``classes`` row bands."""
+    r = np.random.default_rng(seed)
+    x = r.normal(0, 1.0, size=(n, *shape)).astype(np.float32)
+    y = r.integers(0, classes, size=(n,)).astype(np.int64)
+    band = max(1, shape[0] // classes)
+    for i in range(n):
+        b = int(y[i]) * band
+        x[i, b : b + band] += 0.9
+    return x, y
+
+
+def synth_tokens(n: int, seq_len: int, vocab: int, classes: int, seed: int):
+    """Learnable text task: class = token-id parity bias of the sequence."""
+    r = np.random.default_rng(seed)
+    y = r.integers(0, classes, size=(n,)).astype(np.int64)
+    x = r.integers(1, vocab, size=(n, seq_len))
+    for i in range(n):
+        if y[i] == 1:  # bias class-1 sequences toward even token ids
+            x[i] = (x[i] // 2) * 2
+    x[:, -2:] = 0  # padding tail
+    return x.astype(np.int64), y
+
+
+# --- function sources (what a user deploys with `kubeml fn create`) ---
+
+_IMAGE_FN = """
+import numpy as np, optax
+from kubeml_tpu.runtime.model import KubeModel
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.models.{module} import {model}
+
+class Ds(KubeDataset):
+    def __init__(self):
+        super().__init__({dataset!r})
+    def transform(self, x, y):
+        x = x.astype(np.float32)
+        if self.is_training():
+            x = x + np.random.default_rng(0).normal(0, 0.01, x.shape).astype(np.float32)
+        return x, y
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(Ds())
+    def build(self):
+        return {model}(num_classes={classes})
+    def configure_optimizers(self):
+        return optax.sgd(self.lr, momentum=0.9)
+"""
+
+_TEXT_FN = """
+import numpy as np, optax
+from kubeml_tpu.runtime.model import KubeModel
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.models.bert import BertTiny
+
+class Ds(KubeDataset):
+    def __init__(self):
+        super().__init__({dataset!r})
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(Ds())
+    def build(self):
+        return BertTiny(num_classes={classes}, vocab_size={vocab}, max_len={seq_len})
+    def configure_optimizers(self):
+        return optax.adamw(self.lr)
+"""
+
+
+@dataclass
+class Scenario:
+    name: str
+    function_source: str
+    make_data: Callable[[bool], Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]
+    request: TrainRequest
+    quick_request: TrainRequest
+
+
+def _req(fn: str, ds: str, **kw) -> TrainRequest:
+    opts = kw.pop("options", {})
+    return TrainRequest(
+        model_type=fn, function_name=fn, dataset=ds,
+        batch_size=kw.pop("batch_size", 64), epochs=kw.pop("epochs", 2),
+        lr=kw.pop("lr", 0.05), options=TrainOptions(**opts),
+    )
+
+
+def scenarios() -> List[Scenario]:
+    def images(shape, classes, n_train, n_test, n_quick):
+        def make(quick: bool):
+            n = n_quick if quick else n_train
+            xtr, ytr = synth_images(n, shape, classes, seed=1)
+            xte, yte = synth_images(max(64, n // 8) if quick else n_test, shape, classes, seed=2)
+            return xtr, ytr, xte, yte
+
+        return make
+
+    def tokens(seq_len, vocab, classes, n_train, n_quick):
+        def make(quick: bool):
+            n = n_quick if quick else n_train
+            xtr, ytr = synth_tokens(n, seq_len, vocab, classes, seed=1)
+            xte, yte = synth_tokens(max(64, n // 8), seq_len, vocab, classes, seed=2)
+            return xtr, ytr, xte, yte
+
+        return make
+
+    lenet = _IMAGE_FN.format(module="lenet", model="LeNet", dataset="mnist-bench", classes=10)
+    resnet = _IMAGE_FN.format(module="resnet", model="ResNet18", dataset="cifar10-bench", classes=10)
+    vit = _IMAGE_FN.format(module="vit", model="ViTTiny", dataset="cifar100-bench", classes=100)
+    bert = _TEXT_FN.format(dataset="sst2-bench", classes=2, vocab=1000, seq_len=64)
+
+    return [
+        # 1: LeNet/MNIST single function (BASELINE target #1)
+        Scenario(
+            "lenet-mnist", lenet, images((28, 28, 1), 10, 60000, 10000, 640),
+            request=_req("lenet-mnist", "mnist-bench", epochs=5, batch_size=64,
+                         options=dict(default_parallelism=1, static_parallelism=True,
+                                      k=8, goal_accuracy=99.0, precision="f32")),
+            quick_request=_req("lenet-mnist", "mnist-bench", epochs=1, batch_size=32,
+                               options=dict(default_parallelism=1, static_parallelism=True,
+                                            k=4, precision="f32")),
+        ),
+        # 2: ResNet-18/CIFAR-10 data-parallel K=8 (headline, target #2)
+        Scenario(
+            "resnet18-cifar10", resnet, images((32, 32, 3), 10, 50000, 10000, 512),
+            request=_req("resnet18-cifar10", "cifar10-bench", epochs=5, batch_size=128,
+                         options=dict(default_parallelism=8, static_parallelism=True,
+                                      k=8, precision="bf16")),
+            quick_request=_req("resnet18-cifar10", "cifar10-bench", epochs=1, batch_size=32,
+                               options=dict(default_parallelism=2, static_parallelism=True,
+                                            k=2, precision="f32")),
+        ),
+        # 3: ViT-Tiny/CIFAR-100 with train/val transform switch (target #3)
+        Scenario(
+            "vit-cifar100", vit, images((32, 32, 3), 100, 50000, 10000, 512),
+            request=_req("vit-cifar100", "cifar100-bench", epochs=5, batch_size=128,
+                         options=dict(default_parallelism=4, static_parallelism=True,
+                                      k=8, precision="bf16")),
+            quick_request=_req("vit-cifar100", "cifar100-bench", epochs=1, batch_size=32,
+                               options=dict(default_parallelism=2, static_parallelism=True,
+                                            k=2, precision="f32")),
+        ),
+        # 4: BERT/SST-2 fine-tune over text shards (target #4)
+        Scenario(
+            "bert-sst2", bert, tokens(64, 1000, 2, 20000, 256),
+            request=_req("bert-sst2", "sst2-bench", epochs=3, batch_size=64, lr=3e-4,
+                         options=dict(default_parallelism=4, static_parallelism=True,
+                                      k=8, precision="bf16")),
+            quick_request=_req("bert-sst2", "sst2-bench", epochs=1, batch_size=16, lr=3e-4,
+                               options=dict(default_parallelism=2, static_parallelism=True,
+                                            k=2, precision="f32")),
+        ),
+    ]
+
+
+@dataclass
+class ScenarioResult:
+    name: str
+    job_id: str
+    epochs: int
+    train_loss: List[float]
+    accuracy: List[float]
+    parallelism: List[int]
+    epoch_seconds: List[float]
+    wall_seconds: float
+    samples_per_sec: float
+    status: str = "ok"
+    error: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+class ExperimentDriver:
+    """Drives scenarios through an in-process cluster (the generalization of
+    the reference's threaded-PS test pattern) and collects history records."""
+
+    def __init__(self, config: Config, max_parallelism: Optional[int] = None):
+        from ..functions.registry import FunctionRegistry
+        from ..ps.metrics import MetricsRegistry
+        from ..ps.parameter_server import ParameterServer
+        from ..scheduler.scheduler import Scheduler
+        from ..storage.history import HistoryStore
+        from ..storage.store import ShardStore
+
+        self.cfg = config
+        self.store = ShardStore(config=config)
+        self.registry = FunctionRegistry(config=config)
+        self.history_store = HistoryStore(config=config)
+        self.ps = ParameterServer(
+            registry=self.registry, store=self.store,
+            history_store=self.history_store, metrics=MetricsRegistry(),
+            config=config,
+        )
+        self.scheduler = Scheduler(
+            self.ps, config=config, max_parallelism=max_parallelism
+        ).start()
+        self.ps.bind_scheduler(self.scheduler)
+
+    def close(self) -> None:
+        self.scheduler.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # --- one scenario ---
+
+    def prepare(self, sc: Scenario, quick: bool) -> None:
+        if not self.store.exists(sc.request.dataset):
+            xtr, ytr, xte, yte = sc.make_data(quick)
+            self.store.create(sc.request.dataset, xtr, ytr, xte, yte)
+        if not self.registry.exists(sc.request.function_name):
+            self.registry.create(sc.request.function_name, sc.function_source)
+
+    def submit(self, sc: Scenario, quick: bool) -> str:
+        req = sc.quick_request if quick else sc.request
+        return self.scheduler.submit_train(req)
+
+    def wait(self, job_id: str, timeout: float = 1800.0) -> bool:
+        """Poll like the reference polls `task list --short` (experiment.py:110-131).
+
+        Completion = the history record exists (the job always persists one at
+        exit, success or failure) AND the task has left the PS index. The
+        index alone is not enough: a freshly-queued job is not in it yet."""
+        from ..api.errors import JobNotFoundError
+
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            self.ps.wait(job_id, timeout=1.0)
+            try:
+                self.history_store.get(job_id)
+            except JobNotFoundError:
+                time.sleep(0.1)
+                continue
+            if all(t.job_id != job_id for t in self.ps.list_tasks()):
+                return True
+            time.sleep(0.1)
+        return False
+
+    @staticmethod
+    def _job_error(hist) -> Optional[str]:
+        """The error a failed job recorded into its history (engine/job.py)."""
+        if isinstance(hist.task, dict) and hist.task.get("error"):
+            return str(hist.task["error"])
+        return None
+
+    def collect(self, sc: Scenario, job_id: str, wall: float) -> ScenarioResult:
+        hist = self.history_store.get(job_id)
+        err = self._job_error(hist)
+        n_train = self.store.get(sc.request.dataset).num_samples("train")
+        total = n_train * len(hist.train_loss)
+        return ScenarioResult(
+            name=sc.name, job_id=job_id, epochs=len(hist.train_loss),
+            train_loss=hist.train_loss, accuracy=hist.accuracy,
+            parallelism=hist.parallelism, epoch_seconds=hist.epoch_duration,
+            wall_seconds=wall,
+            samples_per_sec=total / max(sum(hist.epoch_duration), 1e-9),
+            status="failed" if err else "ok", error=err,
+        )
+
+    def run(self, sc: Scenario, quick: bool = True) -> ScenarioResult:
+        t0 = time.time()
+        job_id = ""
+        try:
+            self.prepare(sc, quick)
+            job_id = self.submit(sc, quick)
+            if not self.wait(job_id):
+                return ScenarioResult(sc.name, job_id, 0, [], [], [], [],
+                                      time.time() - t0, 0.0, "timeout",
+                                      "job did not finish in time")
+            return self.collect(sc, job_id, time.time() - t0)
+        except Exception as e:
+            return ScenarioResult(sc.name, job_id, 0, [], [], [], [],
+                                  time.time() - t0, 0.0, "error", str(e))
+
+    # --- scenario 5: elastic concurrent jobs ---
+
+    def run_elastic_multijob(self, quick: bool = True) -> ScenarioResult:
+        """Concurrent jobs with ELASTIC parallelism: both complete and the
+        parallelism traces are recorded (BASELINE target #5). Full mode runs
+        ResNet + LeNet (the BASELINE pair); quick mode runs LeNet + LeNet —
+        the mechanism under test is the scheduler's concurrent scale in/out,
+        and ResNet recompiles at each new parallelism are minutes on a CI CPU."""
+        scs = {s.name: s for s in scenarios()}
+        a = scs["lenet-mnist" if quick else "resnet18-cifar10"]
+        b = scs["lenet-mnist"]
+        for s in (a, b):
+            self.prepare(s, quick)
+        t0 = time.time()
+        reqs = []
+        for s in (a, b):
+            req = TrainRequest.from_dict((s.quick_request if quick else s.request).to_dict())
+            req.epochs = max(2, req.epochs)
+            req.options.static_parallelism = False  # the point of the scenario
+            req.options.goal_accuracy = 1000.0  # never early-stop
+            reqs.append(req)
+        ids = [self.scheduler.submit_train(r) for r in reqs]
+        ok = all(self.wait(j) for j in ids)
+        wall = time.time() - t0
+        if not ok:
+            return ScenarioResult("elastic-multijob", ",".join(ids), 0, [], [], [],
+                                  [], wall, 0.0, "timeout", "a job did not finish")
+        hists = [self.history_store.get(j) for j in ids]
+        errors = [e for e in (self._job_error(h) for h in hists) if e]
+        if errors:
+            return ScenarioResult("elastic-multijob", ",".join(ids), 0, [], [], [],
+                                  [], wall, 0.0, "failed", "; ".join(errors))
+        return ScenarioResult(
+            name="elastic-multijob", job_id=",".join(ids),
+            epochs=sum(len(h.train_loss) for h in hists),
+            train_loss=[l for h in hists for l in h.train_loss],
+            accuracy=[x for h in hists for x in h.accuracy],
+            parallelism=[p for h in hists for p in h.parallelism],
+            epoch_seconds=[d for h in hists for d in h.epoch_duration],
+            wall_seconds=wall, samples_per_sec=0.0,
+        )
+
+
+def run_all(config: Optional[Config] = None, quick: bool = True,
+            names: Optional[List[str]] = None) -> List[ScenarioResult]:
+    from ..api.config import get_config
+
+    cfg = config or get_config()
+    cfg.ensure_dirs()
+    results = []
+    # quick mode caps elastic growth: every new parallelism is a recompile
+    with ExperimentDriver(cfg, max_parallelism=4 if quick else None) as driver:
+        for sc in scenarios():
+            if names and sc.name not in names:
+                continue
+            results.append(driver.run(sc, quick=quick))
+        if not names or "elastic-multijob" in names:
+            results.append(driver.run_elastic_multijob(quick=quick))
+    return results
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="kubeml-tpu benchmark scenarios")
+    p.add_argument("--quick", action="store_true", help="CI-sized data and epochs")
+    p.add_argument("--only", nargs="*", default=None, help="scenario names to run")
+    p.add_argument("--out", default=None, help="write results JSON here")
+    args = p.parse_args(argv)
+    results = run_all(quick=args.quick, names=args.only)
+    payload = [r.to_dict() for r in results]
+    print(json.dumps(payload, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=2)
+    failed = [r.name for r in results if r.status != "ok"]
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -248,10 +248,12 @@ class TrainJob:
             self.exit_error = str(e)
             raise KubeMLError(f"job {self.job_id} failed: {e}") from e
         finally:
-            # persist whatever history exists, like the deferred save+finish
-            # (job.go:161-170); tensor GC is implicit — device buffers die with us
-            if self.history.train_loss or self.history.accuracy:
-                self.history_store.save(self.history)
+            # persist the history unconditionally, like the deferred save+finish
+            # (job.go:161-170) — a failed job records its error so pollers can
+            # see the outcome; tensor GC is implicit (device buffers die with us)
+            if self.exit_error is not None and isinstance(self.history.task, dict):
+                self.history.task["error"] = self.exit_error
+            self.history_store.save(self.history)
         return self.history
 
     # --- internals ---
@@ -270,6 +272,7 @@ class TrainJob:
         loader = RoundLoader(handle, "train", plan, transform=dataset.transform)
         rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch + 1)
         losses = []
+        skipped = 0
         for rb in loader:
             if self.stop_event.is_set():
                 break
@@ -283,6 +286,16 @@ class TrainJob:
                              np.flatnonzero(worker_mask == 0.0).tolist())
                 for w in newly_dead:
                     log.warning("%s: worker %d persistently failed", self.job_id, w)
+                # the host knows both masks: when chaos leaves no healthy
+                # data-bearing worker, skip the round here (weights keep their
+                # pre-round value) instead of running a no-participant merge —
+                # so a NaN loss from the device always means real divergence
+                data_bearing = rb.mask.reshape(self.parallelism, -1).sum(axis=1) > 0
+                if float((worker_mask * data_bearing).sum()) == 0.0:
+                    skipped += 1
+                    log.warning("%s: round %d skipped — no healthy data-bearing worker",
+                                self.job_id, rb.round_index)
+                    continue
             with self.tracer.span("job.round", job=self.job_id, epoch=epoch,
                                   round=rb.round_index):
                 self._stacked_vars, loss = self.trainer.sync_round(
@@ -299,22 +312,20 @@ class TrainJob:
         if not losses:
             if self.stop_event.is_set():
                 return float("nan")  # graceful stop before any round completed
+            if skipped:
+                # every round lost all data-bearing workers: no progress at
+                # all — a hard error like the reference's zero responders
+                raise MergeError(
+                    f"job {self.job_id}: all {skipped} rounds this epoch had "
+                    f"no healthy data-bearing worker"
+                )
             raise KubeMLError(f"job {self.job_id}: epoch produced no rounds")
-        # one blocking host read per epoch, not per round (keeps rounds async).
-        # NaN losses mark rounds skipped for zero effective participants (the
-        # engine kept the pre-round weights); an epoch of only skipped rounds
-        # made no progress at all — that is an error, like zero responders
-        vals = np.array([float(l) for l in losses])
-        finite = vals[np.isfinite(vals)]
-        if len(finite) == 0:
-            raise MergeError(
-                f"job {self.job_id}: no round in this epoch had a healthy "
-                f"data-bearing worker"
-            )
-        if len(finite) < len(vals):
-            log.warning("%s: %d/%d rounds skipped (no effective participants)",
-                        self.job_id, len(vals) - len(finite), len(vals))
-        return float(finite.mean())
+        if skipped:
+            log.warning("%s: %d round(s) skipped this epoch (no effective "
+                        "participants)", self.job_id, skipped)
+        # one blocking host read per epoch, not per round (keeps rounds async);
+        # a NaN here is real divergence and stays visible in the history
+        return float(np.mean([float(l) for l in losses]))
 
     def _validate(self, dataset: KubeDataset, handle):
         dataset.set_mode(False)

@@ -237,7 +237,10 @@ class ParameterServer:
             return True
         if record.thread is None:
             return False  # still starting
-        record.thread.join(timeout)
+        try:
+            record.thread.join(timeout)
+        except RuntimeError:
+            return False  # created but not started yet (start_task mid-flight)
         return not record.thread.is_alive()
 
     def infer(self, model_id: str, data) -> list:

@@ -1,0 +1,95 @@
+"""Benchmark scenario suite tests — the BASELINE.md configs run (quick-sized)
+through the real scheduler -> PS -> TrainJob path (port of the reference's
+experiment harness, ml/experiments/common/experiment.py)."""
+
+import numpy as np
+import pytest
+
+from kubeml_tpu.benchmarks.scenarios import (
+    ExperimentDriver,
+    run_all,
+    scenarios,
+    synth_images,
+    synth_tokens,
+)
+
+
+def test_synthetic_generators():
+    x, y = synth_images(32, (28, 28, 1), 10, seed=0)
+    assert x.shape == (32, 28, 28, 1) and y.shape == (32,)
+    assert x.dtype == np.float32 and 0 <= y.min() and y.max() < 10
+    t, ty = synth_tokens(16, 24, 100, 2, seed=0)
+    assert t.shape == (16, 24) and (t[:, -2:] == 0).all()
+    assert set(np.unique(ty)) <= {0, 1}
+
+
+def test_scenario_definitions_cover_baseline():
+    names = [s.name for s in scenarios()]
+    assert names == ["lenet-mnist", "resnet18-cifar10", "vit-cifar100", "bert-sst2"]
+    for s in scenarios():
+        assert s.function_source.strip()
+        assert s.request.dataset and s.request.function_name
+
+
+@pytest.mark.parametrize("name", ["lenet-mnist", "bert-sst2"])
+def test_single_scenario_quick(tmp_config, name):
+    sc = {s.name: s for s in scenarios()}[name]
+    with ExperimentDriver(tmp_config) as driver:
+        result = driver.run(sc, quick=True)
+    assert result.status == "ok", result.error
+    assert result.epochs >= 1
+    assert all(np.isfinite(l) for l in result.train_loss)
+    assert result.samples_per_sec > 0
+
+
+def test_elastic_multijob_quick(tmp_config):
+    with ExperimentDriver(tmp_config, max_parallelism=4) as driver:
+        result = driver.run_elastic_multijob(quick=True)
+    assert result.status == "ok", result.error
+    # two jobs, >= 2 epochs each
+    assert result.epochs >= 4
+    assert len(result.parallelism) == result.epochs
+    assert all(p >= 1 for p in result.parallelism)
+
+
+def test_failed_job_reported_as_failed(tmp_config):
+    """A job that errors must surface status='failed' with the recorded error —
+    a broken benchmark run must never look green."""
+    from kubeml_tpu.benchmarks.scenarios import Scenario, _req, synth_images
+
+    # imports cleanly (passes create-time validation) but fails at job start
+    broken_src = (
+        "from kubeml_tpu.runtime.model import KubeModel\n"
+        "from kubeml_tpu.data.dataset import KubeDataset\n"
+        "class Ds(KubeDataset):\n"
+        "    def __init__(self):\n"
+        "        super().__init__('broken-ds')\n"
+        "class Model(KubeModel):\n"
+        "    def __init__(self):\n"
+        "        raise RuntimeError('intentionally broken model')\n"
+        "    def build(self):\n"
+        "        pass\n"
+    )
+    broken = Scenario(
+        "broken", broken_src,
+        lambda quick: synth_images(64, (8, 8, 1), 4, 0) + synth_images(32, (8, 8, 1), 4, 1),
+        request=_req("broken", "broken-ds"),
+        quick_request=_req("broken", "broken-ds", epochs=1,
+                           options=dict(default_parallelism=1, static_parallelism=True)),
+    )
+    with ExperimentDriver(tmp_config) as driver:
+        result = driver.run(broken, quick=True)
+    assert result.status in ("failed", "error"), result
+    assert result.error
+
+
+def test_run_all_filter_and_json(tmp_config, capsys):
+    from kubeml_tpu.benchmarks.scenarios import main
+
+    rc = main(["--quick", "--only", "lenet-mnist"])
+    assert rc == 0
+    import json
+
+    out = json.loads(capsys.readouterr().out)
+    assert [r["name"] for r in out] == ["lenet-mnist"]
+    assert out[0]["status"] == "ok"
