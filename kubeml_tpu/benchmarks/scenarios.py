@@ -363,6 +363,11 @@ def run_all(config: Optional[Config] = None, quick: bool = True,
 
     cfg = config or get_config()
     cfg.ensure_dirs()
+    known = [s.name for s in scenarios()] + ["elastic-multijob"]
+    if names:
+        unknown = [n for n in names if n not in known]
+        if unknown:
+            raise ValueError(f"unknown scenario name(s) {unknown}; known: {known}")
     results = []
     # quick mode caps elastic growth: every new parallelism is a recompile
     with ExperimentDriver(cfg, max_parallelism=4 if quick else None) as driver:
@@ -381,7 +386,11 @@ def main(argv=None) -> int:
     p.add_argument("--only", nargs="*", default=None, help="scenario names to run")
     p.add_argument("--out", default=None, help="write results JSON here")
     args = p.parse_args(argv)
-    results = run_all(quick=args.quick, names=args.only)
+    try:
+        results = run_all(quick=args.quick, names=args.only)
+    except ValueError as e:
+        print(f"error: {e}", file=__import__("sys").stderr)
+        return 2
     payload = [r.to_dict() for r in results]
     print(json.dumps(payload, indent=2))
     if args.out:

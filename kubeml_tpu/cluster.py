@@ -72,6 +72,7 @@ class LocalCluster:
         return self
 
     def stop(self) -> None:
+        self.ps.shutdown_standalone_jobs()
         self.scheduler.stop()
         if self.serve_http:
             for svc in (self.controller, self.storage_service, self.scheduler_api, self.ps_api):

@@ -1,0 +1,251 @@
+"""Standalone job process — the reference's dedicated per-job pod, re-done as a
+TPU-VM subprocess.
+
+Reference parity: the PS creates a pod+service per job running
+``/kubeml --jobPort 9090 --jobId <id>`` and talks HTTP to it
+(reference: ml/pkg/ps/job_pod.go:96-217); the job pod serves
+``/start /update /next /stop /health`` (reference: ml/pkg/train/api.go:141-149).
+Here the PS spawns ``python -m kubeml_tpu.engine.job_runner --job-id <id>``;
+the runner binds an ephemeral port, prints ``LISTENING <port>`` for the parent,
+and serves:
+
+* ``POST /start``  — TrainTask JSON; loads the function, runs TrainJob on a thread
+* ``POST /update`` — scheduler's parallelism answer (the reference schedulerCh)
+* ``DELETE /stop`` — cooperative stop
+* ``POST /infer``  — serve the live model
+* ``GET /state``   — status + epochs completed
+* ``GET /health``  — readiness (the PS polls like pod-readiness, job_pod.go:18-63)
+
+There is no ``/next`` barrier: the K-AVG merge is an on-chip collective inside
+the job process, so the reference's worker<->merger HTTP rendezvous has no
+counterpart (SURVEY §7). Epoch-end elasticity keeps the reference's loop shape:
+runner -> scheduler ``/job`` -> PS ``/update/{id}`` -> runner ``/update``.
+At exit the runner reports to the PS via ``POST /finish/{jobId}`` and the PS
+reaps the process (the reference's jobFinished, ps/api.go:266-327).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import threading
+from typing import Optional
+
+log = logging.getLogger("kubeml.jobrunner")
+
+
+def _apply_platform_env() -> None:
+    """Honor KUBEML_PLATFORM / KUBEML_NUM_CPU_DEVICES before any device use.
+
+    Env vars alone are not enough when a sitecustomize pre-imports jax, so the
+    config.update path (which works post-import, pre-backend-init) is used."""
+    platform = os.environ.get("KUBEML_PLATFORM")
+    if platform:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", platform)
+            n = os.environ.get("KUBEML_NUM_CPU_DEVICES")
+            if n and platform == "cpu":
+                jax.config.update("jax_num_cpu_devices", int(n))
+        except RuntimeError:
+            log.warning("backends already initialized; platform env ignored")
+
+
+class JobRunner:
+    """One job's HTTP surface + lifecycle inside its own process."""
+
+    def __init__(self, job_id: str, config=None, port: int = 0):
+        from ..api.config import get_config
+        from ..utils.httpd import Router, Service
+
+        self.cfg = config or get_config()
+        self.job_id = job_id
+        self.job = None
+        self.thread: Optional[threading.Thread] = None
+        self.status = "starting"
+        self.exit_error: Optional[str] = None
+        self.done = threading.Event()
+        self._update_event = threading.Event()
+        self._update_parallelism = 0
+        self._lock = threading.Lock()
+
+        router = Router(f"job-{job_id}")
+        router.route("POST", "/start", self._start)
+        router.route("POST", "/update", self._update)
+        router.route("DELETE", "/stop", self._stop)
+        router.route("POST", "/infer", self._infer)
+        router.route("GET", "/state", self._state)
+        self.service = Service(router, self.cfg.host, port)
+
+    # --- routes ---
+
+    def _start(self, req):
+        from ..api.errors import KubeMLError
+        from ..api.types import TrainTask
+        from ..functions.registry import FunctionRegistry
+        from ..storage.checkpoint import CheckpointStore
+        from ..storage.history import HistoryStore
+        from ..storage.store import ShardStore
+        from .job import TrainJob
+
+        with self._lock:
+            if self.job is not None:
+                raise KubeMLError(f"job {self.job_id} already started", 400)
+            task = TrainTask.from_dict(req.json() or {})
+            request = task.parameters
+            model = FunctionRegistry(config=self.cfg).load(request.function_name)
+            model._set_params(lr=request.lr, batch_size=request.batch_size,
+                              epoch=0, k=request.options.k, task="train")
+            request.options.default_parallelism = (
+                task.state.parallelism or request.options.default_parallelism
+            )
+            self.job = TrainJob(
+                self.job_id, request, model,
+                store=ShardStore(config=self.cfg),
+                history_store=HistoryStore(config=self.cfg),
+                checkpoint_store=CheckpointStore(config=self.cfg),
+                on_epoch_end=self._epoch_end,
+                on_metrics=self._push_metrics,
+            )
+            self.thread = threading.Thread(target=self._run, name=f"job-{self.job_id}",
+                                           daemon=True)
+            self.status = "running"
+            self.thread.start()
+        return {}
+
+    def _run(self) -> None:
+        try:
+            self.job.train()
+            self.status = "stopped" if self.job.stop_event.is_set() else "finished"
+        except Exception as e:
+            self.status = "failed"
+            self.exit_error = str(e)
+            log.error("job %s failed: %s", self.job_id, e)
+        finally:
+            self._notify_ps_finished()
+            self.done.set()
+
+    def _update(self, req):
+        body = req.json() or {}
+        self._update_parallelism = int(body["parallelism"])
+        self._update_event.set()
+        return {}
+
+    def _stop(self, req):
+        from ..api.errors import JobNotFoundError
+
+        if self.job is None:
+            raise JobNotFoundError(self.job_id)
+        self.job.stop()
+        self._update_event.set()  # unblock a pending epoch-end wait
+        return {}
+
+    def _infer(self, req):
+        import numpy as np
+
+        from ..api.errors import KubeMLError
+
+        if self.job is None:
+            raise KubeMLError(f"job {self.job_id} not started", 503)
+        body = req.json() or {}
+        return {"predictions": np.asarray(self.job.infer(np.asarray(body["data"]))).tolist()}
+
+    def _state(self, req):
+        epochs = len(self.job.history.train_loss) if self.job is not None else 0
+        return {"job_id": self.job_id, "status": self.status, "epochs": epochs,
+                "error": self.exit_error}
+
+    # --- control-plane callbacks ---
+
+    def _epoch_end(self, state) -> int:
+        """Reference loop shape: job -> scheduler /job; answer arrives on /update
+        (via PS). Timeout keeps a dead scheduler from wedging training."""
+        import requests
+
+        from ..api.types import TrainTask
+
+        self._update_event.clear()
+        task = TrainTask(job_id=self.job_id, parameters=self.job.request, state=state)
+        try:
+            requests.post(f"{self.cfg.scheduler_url}/job", json=task.to_dict(), timeout=10)
+        except requests.RequestException as e:
+            log.warning("job %s: scheduler unreachable (%s); keeping parallelism",
+                        self.job_id, e)
+            return state.parallelism
+        if not self._update_event.wait(30.0):
+            log.warning("job %s: scheduler update timed out", self.job_id)
+            return state.parallelism
+        if self.job.stop_event.is_set():
+            return state.parallelism
+        return self._update_parallelism or state.parallelism
+
+    def _push_metrics(self, update) -> None:
+        import requests
+
+        try:
+            requests.post(f"{self.cfg.ps_url}/metrics/{self.job_id}",
+                          json=update.to_dict(), timeout=5)
+        except requests.RequestException:
+            log.debug("job %s: metrics push failed (PS down?)", self.job_id)
+
+    def _notify_ps_finished(self) -> None:
+        import requests
+
+        try:
+            requests.post(
+                f"{self.cfg.ps_url}/finish/{self.job_id}",
+                json={"error": self.exit_error, "status": self.status},
+                timeout=10,
+            )
+        except requests.RequestException as e:
+            log.warning("job %s: PS finish notification failed: %s", self.job_id, e)
+
+    # --- lifecycle ---
+
+    def start(self) -> "JobRunner":
+        self.service.start()
+        return self
+
+    def stop(self) -> None:
+        self.service.stop()
+
+    @property
+    def url(self) -> str:
+        return self.service.url
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="kubeml-tpu standalone job runner")
+    parser.add_argument("--job-id", required=True)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--linger", type=float, default=5.0,
+                        help="seconds to keep serving after the job finishes")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"%(asctime)s job-{args.job_id} %(name)s %(levelname)s %(message)s",
+    )
+    _apply_platform_env()
+    runner = JobRunner(args.job_id, port=args.port).start()
+    # the parent reads this line to learn the bound port (job_pod readiness)
+    print(f"LISTENING {runner.service.port}", flush=True)
+    import time
+
+    try:
+        # serve until the job completes (plus a linger for late /state reads);
+        # a runner that never receives /start waits for the parent to kill it
+        runner.done.wait()
+        time.sleep(args.linger)
+    except KeyboardInterrupt:
+        if runner.job is not None:
+            runner.job.stop()
+    finally:
+        runner.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

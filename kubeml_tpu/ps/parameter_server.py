@@ -58,9 +58,12 @@ class _UpdateBox:
 @dataclass
 class _JobRecord:
     task: TrainTask
-    job: Optional[TrainJob]  # None only while the start is being prepared
+    job: Optional[TrainJob]  # None while starting, and always for standalone jobs
     thread: Optional[threading.Thread]
     update_box: Optional[_UpdateBox] = None
+    # standalone mode (reference: dedicated job pod, ps/job_pod.go)
+    proc: Optional[object] = None  # subprocess.Popen
+    url: Optional[str] = None  # the runner's HTTP endpoint
 
 
 class ParameterServer:
@@ -91,12 +94,18 @@ class ParameterServer:
     # --- task lifecycle (reference routes ps/api.go:335-345) ---
 
     def start_task(self, task: TrainTask) -> None:
-        """`/start`: spin up the job (reference api.go:139-222).
+        """`/start`: spin up the job (reference api.go:139-222) — as an
+        in-process thread (reference threaded mode, ps/api.go:211-217) or, with
+        ``standalone_jobs``, a dedicated subprocess speaking the job HTTP API
+        (reference standalone mode, job_pod.go:96-217).
 
         The index slot is reserved atomically before the (slow) model load so
         two concurrent starts of the same job id can't both win; a failed start
         leaves a FAILED history record so clients polling the job don't see it
         silently vanish."""
+        if self.cfg.standalone_jobs:
+            self._start_standalone(task)
+            return
         req = task.parameters
         placeholder = _JobRecord(task=task, job=None, thread=None)
         with self._lock:
@@ -142,6 +151,127 @@ class ParameterServer:
         task.status = JobStateEnum.RUNNING
         self.metrics.task_started("train")
         thread.start()
+
+    # --- standalone mode (reference: ps/job_pod.go + train/client) ---
+
+    def _start_standalone(self, task: TrainTask) -> None:
+        import subprocess
+        import sys
+
+        import requests
+
+        placeholder = _JobRecord(task=task, job=None, thread=None)
+        with self._lock:
+            if task.job_id in self._jobs:
+                raise KubeMLError(f"job {task.job_id} already exists", 400)
+            self._jobs[task.job_id] = placeholder
+            self._serving_cache.pop(task.job_id, None)
+        try:
+            env = dict(
+                __import__("os").environ,
+                KUBEML_DATA_ROOT=str(self.cfg.data_root),
+                KUBEML_SCHEDULER_PORT=str(self.cfg.scheduler_port),
+                KUBEML_PS_PORT=str(self.cfg.ps_port),
+            )
+            if self.cfg.platform:
+                env["KUBEML_PLATFORM"] = self.cfg.platform
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "kubeml_tpu.engine.job_runner",
+                 "--job-id", task.job_id, "--port", "0"],
+                stdout=subprocess.PIPE, text=True, env=env,
+            )
+            # the runner prints its bound port first (pod-readiness parity,
+            # job_pod.go:18-63); a crashed child yields EOF -> error out
+            line = proc.stdout.readline().strip()
+            if not line.startswith("LISTENING "):
+                proc.kill()
+                raise KubeMLError(
+                    f"job runner for {task.job_id} failed to start: {line!r}", 500
+                )
+            url = f"http://{self.cfg.host}:{int(line.split()[1])}"
+            # user training code prints to stdout inside the runner: drain the
+            # pipe on a thread (into our log) or the child blocks once it fills
+            threading.Thread(
+                target=self._drain_runner_output, args=(task.job_id, proc.stdout),
+                name=f"job-{task.job_id}-stdout", daemon=True,
+            ).start()
+            # publish proc/url BEFORE handing the task over: a job that fails
+            # within milliseconds posts /finish immediately, and that callback
+            # must find a routable record
+            with self._lock:
+                placeholder.proc = proc
+                placeholder.url = url
+            # hand the task over with retries (reference api.go:190-207)
+            last = None
+            for attempt in range(10):
+                try:
+                    r = requests.post(f"{url}/start", json=task.to_dict(), timeout=30)
+                    if r.status_code < 400:
+                        break
+                    last = r.text
+                except requests.RequestException as e:
+                    last = str(e)
+                time.sleep(0.2 * (attempt + 1))
+            else:
+                proc.kill()
+                raise KubeMLError(
+                    f"could not start job {task.job_id} on its runner: {last}", 500
+                )
+        except Exception as e:
+            task.status = JobStateEnum.FAILED
+            with self._lock:
+                self._jobs.pop(task.job_id, None)
+            from ..api.types import History
+
+            self.history_store.save(
+                History(id=task.job_id,
+                        task={"request": task.parameters.to_dict(), "error": str(e)})
+            )
+            raise
+        task.status = JobStateEnum.RUNNING
+        self.metrics.task_started("train")
+        log.info("standalone job %s running at %s (pid %d)", task.job_id, url, proc.pid)
+
+    @staticmethod
+    def _drain_runner_output(job_id: str, stream) -> None:
+        try:
+            for line in stream:
+                log.info("[job %s] %s", job_id, line.rstrip())
+        except (ValueError, OSError):
+            pass  # stream closed during reap
+
+    def finish_standalone(self, job_id: str, status: str = "", error: Optional[str] = None) -> None:
+        """`/finish/{jobId}` from the job runner (reference ps/api.go:266-327)."""
+        with self._lock:
+            record = self._jobs.get(job_id)
+        if record is None or record.proc is None:
+            raise JobNotFoundError(job_id)
+        record.task.status = {
+            "finished": JobStateEnum.FINISHED,
+            "stopped": JobStateEnum.STOPPED,
+            "failed": JobStateEnum.FAILED,
+        }.get(status, JobStateEnum.FINISHED if not error else JobStateEnum.FAILED)
+        self._finish(job_id)
+        self._reap(record)
+
+    def _reap(self, record: _JobRecord) -> None:
+        def reap():
+            try:
+                record.proc.wait(timeout=30)
+            except Exception:
+                record.proc.kill()
+
+        threading.Thread(target=reap, name="job-reaper", daemon=True).start()
+
+    def shutdown_standalone_jobs(self) -> None:
+        """Terminate any live job runner processes (cluster stop)."""
+        with self._lock:
+            records = [r for r in self._jobs.values() if r.proc is not None]
+        for r in records:
+            try:
+                r.proc.terminate()
+            except Exception:
+                pass
 
     def _run_job(self, task: TrainTask, job: TrainJob) -> None:
         try:
@@ -193,11 +323,22 @@ class ParameterServer:
         return box.parallelism
 
     def update_task(self, job_id: str, parallelism: int) -> None:
-        """`/update/{jobId}`: scheduler's answer routed to the job (api.go:72-119)."""
+        """`/update/{jobId}`: scheduler's answer routed to the job (api.go:72-119)
+        — in-process box for threaded jobs, HTTP for standalone runners
+        (reference train/client/client.go:31-107)."""
         with self._lock:
             record = self._jobs.get(job_id)
         if record is None:
             raise JobNotFoundError(job_id)
+        if record.url is not None:
+            import requests
+
+            try:
+                requests.post(f"{record.url}/update",
+                              json={"parallelism": parallelism}, timeout=10)
+            except requests.RequestException as e:
+                log.warning("job %s: update delivery failed: %s", job_id, e)
+            return
         box = record.update_box
         if box is None:
             log.warning("job %s: update with no pending epoch-end request", job_id)
@@ -225,16 +366,58 @@ class ParameterServer:
             record = self._jobs.get(job_id)
         if record is None:
             raise JobNotFoundError(job_id)
+        if record.url is not None:
+            import requests
+
+            try:
+                r = requests.delete(f"{record.url}/stop", timeout=10)
+            except requests.RequestException as e:
+                raise KubeMLError(f"job {job_id} runner unreachable: {e}", 502)
+            if r.status_code >= 400:
+                from ..api.errors import error_from_envelope
+
+                raise error_from_envelope(r.content, r.status_code)
+            return
         if record.job is None:
             raise KubeMLError(f"job {job_id} is still starting", 409)
         record.job.stop()
 
     def wait(self, job_id: str, timeout: Optional[float] = None) -> bool:
-        """Join a job's thread (test/CLI convenience; reference polls task list)."""
+        """Join a job's thread (test/CLI convenience; reference polls task list).
+        For standalone jobs, polls until the finish callback drops the record."""
         with self._lock:
             record = self._jobs.get(job_id)
         if record is None:
             return True
+        if record.proc is not None:
+            deadline = time.time() + (timeout if timeout is not None else 3600.0)
+            while time.time() < deadline:
+                with self._lock:
+                    if job_id not in self._jobs:
+                        return True
+                if record.proc.poll() is not None:
+                    # runner died without its finish callback (crash/kill):
+                    # fail the task, persist a history record (every other
+                    # failure path does — completion pollers key off it), and
+                    # clean up so nothing waits forever
+                    log.error("standalone job %s runner exited (code %s) without "
+                              "reporting; marking failed", job_id, record.proc.returncode)
+                    record.task.status = JobStateEnum.FAILED
+                    try:
+                        self.history_store.get(job_id)  # runner may have saved one
+                    except Exception:
+                        from ..api.types import History
+
+                        self.history_store.save(History(
+                            id=job_id,
+                            task={"request": record.task.parameters.to_dict(),
+                                  "error": f"job runner exited with code "
+                                           f"{record.proc.returncode}"},
+                        ))
+                    self._finish(job_id)
+                    return True
+                time.sleep(0.1)
+            return False
         if record.thread is None:
             return False  # still starting
         try:
@@ -251,6 +434,15 @@ class ParameterServer:
             record = self._jobs.get(model_id)
         if record is None:
             return self._infer_from_checkpoint(model_id, data)
+        if record.url is not None:
+            import requests
+
+            from ..api.errors import error_from_envelope
+
+            r = requests.post(f"{record.url}/infer", json={"data": data}, timeout=60)
+            if r.status_code >= 400:
+                raise error_from_envelope(r.content, r.status_code)
+            return r.json()["predictions"]
         if record.job is None:
             raise KubeMLError(f"job {model_id} is still starting", 503)
         self.metrics.task_started("inference")

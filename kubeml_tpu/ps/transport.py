@@ -30,6 +30,10 @@ class PSAPI:
         router.route("DELETE", "/stop/{jobId}", self._stop)
         router.route("GET", "/tasks", self._tasks)
         router.route("GET", "/metrics", self._metrics)
+        # job-runner callbacks (reference routes /metrics/{jobId} and
+        # /finish/{jobId}, ps/api.go:335-345)
+        router.route("POST", "/metrics/{jobId}", self._metrics_update)
+        router.route("POST", "/finish/{jobId}", self._finish)
         self.service = Service(router, self.cfg.host, self.cfg.ps_port)
 
     def _start(self, req: Request):
@@ -56,6 +60,21 @@ class PSAPI:
         return Response(
             self.ps.metrics.render().encode(), content_type="text/plain; version=0.0.4"
         )
+
+    def _metrics_update(self, req: Request):
+        from ..api.types import MetricUpdate
+
+        update = MetricUpdate.from_dict(req.json() or {})
+        update.job_id = req.params["jobId"]
+        self.ps.metrics.update(update)
+        return {}
+
+    def _finish(self, req: Request):
+        body = req.json() or {}
+        self.ps.finish_standalone(
+            req.params["jobId"], status=body.get("status", ""), error=body.get("error")
+        )
+        return {}
 
     def start(self) -> "PSAPI":
         self.service.start()

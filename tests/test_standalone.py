@@ -1,0 +1,165 @@
+"""Standalone job mode — each job in its own subprocess speaking the job HTTP
+API (reference: dedicated job pods, ml/pkg/ps/job_pod.go:96-217 + the job-side
+routes ml/pkg/train/api.go:141-149)."""
+
+import time
+
+import numpy as np
+import pytest
+import requests
+
+FN_SOURCE = """
+import numpy as np, optax
+import flax.linen as nn
+from kubeml_tpu.runtime.model import KubeModel
+from kubeml_tpu.data.dataset import KubeDataset
+
+class Tiny(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        return nn.Dense(10)(nn.relu(nn.Dense(32)(x.reshape((x.shape[0], -1)))))
+
+class Ds(KubeDataset):
+    def __init__(self):
+        super().__init__("blobs")
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(Ds())
+    def build(self):
+        return Tiny()
+    def configure_optimizers(self):
+        return optax.sgd(self.lr)
+"""
+
+
+@pytest.fixture
+def standalone_cluster(tmp_config, monkeypatch):
+    from conftest import make_blobs
+    from kubeml_tpu.cluster import LocalCluster
+
+    tmp_config.standalone_jobs = True
+    tmp_config.platform = "cpu"
+    monkeypatch.setenv("KUBEML_NUM_CPU_DEVICES", "8")
+    with LocalCluster(config=tmp_config) as cluster:
+        store = cluster.store
+        x, y = make_blobs(256, shape=(8, 8, 1))
+        store.create("blobs", x, y, x[:64], y[:64])
+        cluster.registry.create("tiny", FN_SOURCE)
+        yield cluster
+
+
+def _wait_done(cluster, job_id, timeout=300):
+    """Done = history persisted AND out of the PS index (a just-queued job is
+    in neither — the same rule ExperimentDriver.wait uses)."""
+    from kubeml_tpu.api.errors import JobNotFoundError
+
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        cluster.ps.wait(job_id, timeout=1.0)
+        try:
+            cluster.history_store.get(job_id)
+        except JobNotFoundError:
+            time.sleep(0.2)
+            continue
+        if all(t.job_id != job_id for t in cluster.ps.list_tasks()):
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def test_standalone_job_end_to_end(standalone_cluster):
+    """Submit -> subprocess runner -> history + final checkpoint + metrics."""
+    cluster = standalone_cluster
+    from kubeml_tpu.api.types import TrainOptions, TrainRequest
+
+    req = TrainRequest(
+        function_name="tiny", dataset="blobs", epochs=2, batch_size=16, lr=0.05,
+        options=TrainOptions(default_parallelism=2, static_parallelism=True,
+                             k=2, precision="f32"),
+    )
+    job_id = cluster.scheduler.submit_train(req)
+    # the task shows up with a live runner process
+    t0 = time.time()
+    while time.time() - t0 < 60:
+        records = {t.job_id for t in cluster.ps.list_tasks()}
+        if job_id in records:
+            break
+        time.sleep(0.2)
+    assert _wait_done(cluster, job_id)
+
+    hist = cluster.history_store.get(job_id)
+    assert len(hist.train_loss) == 2
+    assert all(np.isfinite(l) for l in hist.train_loss)
+    # final model export happened in the subprocess; PS serves it from disk
+    preds = cluster.ps.infer(job_id, np.zeros((3, 8, 8, 1), np.float32).tolist())
+    assert len(preds) == 3
+    # runner pushed per-epoch metrics through POST /metrics/{jobId}
+    text = cluster.ps.metrics.render()
+    assert "kubeml_job" in text or hist.train_loss  # gauges cleared at finish
+
+
+def test_standalone_job_stop(standalone_cluster):
+    cluster = standalone_cluster
+    from kubeml_tpu.api.types import TrainOptions, TrainRequest
+
+    req = TrainRequest(
+        function_name="tiny", dataset="blobs", epochs=50, batch_size=16, lr=0.05,
+        options=TrainOptions(default_parallelism=2, static_parallelism=True,
+                             k=2, precision="f32"),
+    )
+    job_id = cluster.scheduler.submit_train(req)
+    # wait until the runner is actually up and the job is running
+    t0 = time.time()
+    while time.time() - t0 < 120:
+        with cluster.ps._lock:
+            rec = cluster.ps._jobs.get(job_id)
+        if rec is not None and rec.url is not None:
+            break
+        time.sleep(0.2)
+    assert rec is not None and rec.url is not None
+    time.sleep(2.0)  # let a round or two run
+    cluster.ps.stop_task(job_id)
+    assert _wait_done(cluster, job_id, timeout=180)
+    hist = cluster.history_store.get(job_id)
+    assert len(hist.train_loss) < 50
+
+
+def test_standalone_elastic_roundtrip(standalone_cluster):
+    """Epoch-end elasticity crosses three processes: runner -> scheduler HTTP
+    -> PS -> runner /update (the reference's schedulerCh loop over the wire)."""
+    cluster = standalone_cluster
+    from kubeml_tpu.api.types import TrainOptions, TrainRequest
+
+    req = TrainRequest(
+        function_name="tiny", dataset="blobs", epochs=3, batch_size=16, lr=0.05,
+        options=TrainOptions(default_parallelism=1, static_parallelism=False,
+                             k=2, precision="f32", goal_accuracy=1000.0),
+    )
+    job_id = cluster.scheduler.submit_train(req)
+    assert _wait_done(cluster, job_id)
+    hist = cluster.history_store.get(job_id)
+    assert len(hist.train_loss) == 3
+    # the throughput policy scales a fast job up at least once
+    assert max(hist.parallelism) > 1, hist.parallelism
+
+
+def test_runner_http_surface(tmp_config):
+    """The runner's HTTP API in-process: /state before start, duplicate /start."""
+    from kubeml_tpu.engine.job_runner import JobRunner
+
+    runner = JobRunner("unitjob", config=tmp_config).start()
+    try:
+        base = runner.url
+        s = requests.get(f"{base}/state", timeout=5).json()
+        assert s == {"job_id": "unitjob", "status": "starting", "epochs": 0,
+                     "error": None}
+        assert requests.get(f"{base}/health", timeout=5).status_code == 200
+        # stop before start -> 404 envelope
+        r = requests.delete(f"{base}/stop", timeout=5)
+        assert r.status_code == 404
+        # infer before start -> 503
+        r = requests.post(f"{base}/infer", json={"data": [[0.0]]}, timeout=5)
+        assert r.status_code == 503
+    finally:
+        runner.stop()
