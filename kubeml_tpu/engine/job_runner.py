@@ -68,8 +68,10 @@ class JobRunner:
         self.status = "starting"
         self.exit_error: Optional[str] = None
         self.done = threading.Event()
-        self._update_event = threading.Event()
-        self._update_parallelism = 0
+        # a FRESH box per epoch-end request: a late answer for epoch N must not
+        # satisfy epoch N+1's wait (the PS allocates per-request _UpdateBoxes
+        # for the same reason)
+        self._update_box: Optional[list] = None  # [Event, parallelism]
         self._lock = threading.Lock()
 
         router = Router(f"job-{job_id}")
@@ -130,8 +132,13 @@ class JobRunner:
 
     def _update(self, req):
         body = req.json() or {}
-        self._update_parallelism = int(body["parallelism"])
-        self._update_event.set()
+        with self._lock:
+            box = self._update_box
+        if box is None:
+            log.warning("job %s: update with no pending epoch-end request", self.job_id)
+            return {}
+        box[1] = int(body["parallelism"])
+        box[0].set()
         return {}
 
     def _stop(self, req):
@@ -140,7 +147,9 @@ class JobRunner:
         if self.job is None:
             raise JobNotFoundError(self.job_id)
         self.job.stop()
-        self._update_event.set()  # unblock a pending epoch-end wait
+        with self._lock:
+            if self._update_box is not None:  # unblock a pending epoch-end wait
+                self._update_box[0].set()
         return {}
 
     def _infer(self, req):
@@ -167,7 +176,9 @@ class JobRunner:
 
         from ..api.types import TrainTask
 
-        self._update_event.clear()
+        box = [threading.Event(), 0]
+        with self._lock:
+            self._update_box = box
         task = TrainTask(job_id=self.job_id, parameters=self.job.request, state=state)
         try:
             requests.post(f"{self.cfg.scheduler_url}/job", json=task.to_dict(), timeout=10)
@@ -175,12 +186,17 @@ class JobRunner:
             log.warning("job %s: scheduler unreachable (%s); keeping parallelism",
                         self.job_id, e)
             return state.parallelism
-        if not self._update_event.wait(30.0):
-            log.warning("job %s: scheduler update timed out", self.job_id)
-            return state.parallelism
-        if self.job.stop_event.is_set():
-            return state.parallelism
-        return self._update_parallelism or state.parallelism
+        try:
+            if not box[0].wait(30.0):
+                log.warning("job %s: scheduler update timed out", self.job_id)
+                return state.parallelism
+            if self.job.stop_event.is_set():
+                return state.parallelism
+            return box[1] or state.parallelism
+        finally:
+            with self._lock:
+                if self._update_box is box:
+                    self._update_box = None  # late answers hit the warning path
 
     def _push_metrics(self, update) -> None:
         import requests

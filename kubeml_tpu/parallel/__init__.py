@@ -15,12 +15,17 @@ insert collectives; hand-written collectives (shard_map + ppermute) only where
 the schedule matters (ring attention, a2a expert dispatch).
 """
 
+from .distributed import global_mesh, init_distributed, local_batch_slice, num_slices
 from .mesh import make_mesh, mesh_shape_for
 from .moe import MoEBlock, MoEMlp, MoETiny, MoETransformer
 from .pipeline import PipelinedLM, PipelineTrainer, gpipe
 from .ring import ring_attention
 
 __all__ = [
+    "global_mesh",
+    "init_distributed",
+    "local_batch_slice",
+    "num_slices",
     "MoEBlock",
     "MoEMlp",
     "MoETiny",

@@ -1,0 +1,143 @@
+"""Multi-host / multi-slice distributed setup.
+
+The reference scales by adding serverless function invocations behind one
+Redis; its "distributed backend" is HTTP + Redis blobs (SURVEY §2.4: no
+NCCL/MPI). The TPU-native equivalent is JAX's multi-controller runtime: every
+TPU-VM host runs the same program, ``jax.distributed`` wires the processes,
+and collectives ride ICI within a slice and DCN across slices. This module
+owns that wiring:
+
+* :func:`init_distributed` — idempotent ``jax.distributed.initialize`` with
+  env-driven defaults (``KUBEML_COORDINATOR``, ``KUBEML_NUM_PROCESSES``,
+  ``KUBEML_PROCESS_ID``; on Cloud TPU all three auto-detect).
+* :func:`global_mesh` — a mesh over ALL global devices. On multi-slice
+  topologies the data-parallel axis is laid out across slices (DCN) and the
+  model axes (tp/sp/ep/pp) stay within a slice (ICI), the scaling-book
+  hybrid-mesh recipe, via ``mesh_utils.create_hybrid_device_mesh``; on a
+  single slice / single host it degrades to the plain local mesh.
+* :func:`local_batch_slice` — which rows of a global batch this process feeds
+  (hosts feed only their addressable shard of a globally-sharded array).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import AXIS_ORDER, make_mesh, mesh_shape_for
+
+log = logging.getLogger("kubeml.distributed")
+
+_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize the multi-controller runtime; returns True when distributed.
+
+    Single-process (no coordinator configured, one process) is a no-op —
+    the same binary serves laptop CPU, one TPU VM, and a multi-host pod.
+    """
+    global _initialized
+    if _initialized:
+        return jax.process_count() > 1
+    coordinator_address = coordinator_address or os.environ.get("KUBEML_COORDINATOR")
+    if num_processes is None:
+        env = os.environ.get("KUBEML_NUM_PROCESSES")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("KUBEML_PROCESS_ID")
+        process_id = int(env) if env else None
+    if coordinator_address is None and num_processes in (None, 1):
+        log.info("single-process mode (no KUBEML_COORDINATOR)")
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log.info("distributed: process %d/%d, %d local + %d global devices",
+             jax.process_index(), jax.process_count(),
+             jax.local_device_count(), jax.device_count())
+    return True
+
+
+def num_slices() -> int:
+    """Number of ICI-connected slices among the global devices (1 when the
+    backend does not report slice topology, e.g. CPU)."""
+    slices = {getattr(d, "slice_index", 0) for d in jax.devices()}
+    return max(1, len(slices))
+
+
+def global_mesh(
+    shape: Optional[Dict[str, int]] = None,
+    dcn_axis: str = "dp",
+    **axes: int,
+) -> Mesh:
+    """Mesh over all global devices with DCN-aware layout.
+
+    Model axes (tp/sp/ep/pp) must fit within one slice — their collectives are
+    in the steady-state critical path and belong on ICI. The ``dcn_axis``
+    (default ``dp``: gradient/weight averaging once per step or per K steps)
+    spans slices. Falls back to a plain mesh on single-slice/CPU topologies.
+    """
+    devices = jax.devices()
+    n_slices = num_slices()
+    if shape is None:
+        shape = mesh_shape_for(len(devices), **axes)
+    if n_slices == 1:
+        return make_mesh(shape=shape, devices=devices)
+
+    from jax.experimental import mesh_utils
+
+    if dcn_axis not in shape:
+        raise ValueError(
+            f"dcn_axis {dcn_axis!r} missing from mesh shape {shape}; on a "
+            f"{n_slices}-slice topology one axis must span the slices"
+        )
+    per_slice = len(devices) // n_slices
+    model = int(np.prod([s for ax, s in shape.items() if ax != dcn_axis]))
+    if per_slice % model != 0:
+        raise ValueError(
+            f"model axes use {model} devices which does not divide the "
+            f"{per_slice}-device slice; keep tp/sp/ep/pp within one slice"
+        )
+    if shape[dcn_axis] % n_slices != 0:
+        raise ValueError(
+            f"{dcn_axis}={shape[dcn_axis]} must be divisible by the "
+            f"{n_slices} slices it spans"
+        )
+    names = tuple(ax for ax in AXIS_ORDER if ax in shape)
+    ici_shape = [shape[ax] // n_slices if ax == dcn_axis else shape[ax] for ax in names]
+    dcn_shape = [n_slices if ax == dcn_axis else 1 for ax in names]
+    grid = mesh_utils.create_hybrid_device_mesh(
+        ici_shape, dcn_shape, devices=devices
+    )
+    return Mesh(grid, names)
+
+
+def local_batch_slice(global_batch: int) -> Tuple[int, int]:
+    """[start, end) rows of the global batch this process should feed — hosts
+    materialize only their shard (reference counterpart: each function loads
+    only its contiguous doc range, python/kubeml/kubeml/util.py:46-56).
+
+    The global batch must divide evenly: silently dropping remainder rows
+    would leave shards of a globally-sharded array unmaterialized."""
+    n = max(1, jax.process_count())
+    if global_batch % n != 0:
+        raise ValueError(
+            f"global batch {global_batch} must be divisible by the "
+            f"{n} host processes"
+        )
+    per = global_batch // n
+    start = jax.process_index() * per
+    return start, start + per

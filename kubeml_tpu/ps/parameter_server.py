@@ -84,6 +84,7 @@ class ParameterServer:
         self.devices = devices
         self.scheduler = None  # bound after construction (circular dep)
         self._jobs: Dict[str, _JobRecord] = {}
+        self._monitor: Optional[threading.Thread] = None  # standalone liveness watch
         self._serving_cache: Dict[str, tuple] = {}  # (model, vars, ckpt mtime)
         self._ckpt_store = CheckpointStore(config=self.cfg)
         self._lock = threading.RLock()
@@ -107,13 +108,7 @@ class ParameterServer:
             self._start_standalone(task)
             return
         req = task.parameters
-        placeholder = _JobRecord(task=task, job=None, thread=None)
-        with self._lock:
-            if task.job_id in self._jobs:
-                raise KubeMLError(f"job {task.job_id} already exists", 400)
-            self._jobs[task.job_id] = placeholder
-            # a restarted job id invalidates any cached finished-model weights
-            self._serving_cache.pop(task.job_id, None)
+        placeholder = self._reserve_slot(task)
         try:
             model = self.registry.load(req.function_name)
             model._set_params(
@@ -134,14 +129,7 @@ class ParameterServer:
                 devices=self.devices,
             )
         except Exception as e:
-            task.status = JobStateEnum.FAILED
-            with self._lock:
-                self._jobs.pop(task.job_id, None)
-            from ..api.types import History
-
-            self.history_store.save(
-                History(id=task.job_id, task={"request": req.to_dict(), "error": str(e)})
-            )
+            self._fail_start(task, e)
             raise
         thread = threading.Thread(
             target=self._run_job, args=(task, job), name=f"job-{task.job_id}", daemon=True
@@ -152,6 +140,30 @@ class ParameterServer:
         self.metrics.task_started("train")
         thread.start()
 
+    def _reserve_slot(self, task: TrainTask) -> _JobRecord:
+        """Reserve the job-index slot atomically (duplicate start -> 400) and
+        invalidate any cached finished-model weights for a reused id."""
+        placeholder = _JobRecord(task=task, job=None, thread=None)
+        with self._lock:
+            if task.job_id in self._jobs:
+                raise KubeMLError(f"job {task.job_id} already exists", 400)
+            self._jobs[task.job_id] = placeholder
+            self._serving_cache.pop(task.job_id, None)
+        return placeholder
+
+    def _fail_start(self, task: TrainTask, error: Exception) -> None:
+        """Failed-start bookkeeping: FAILED status, slot freed, error history
+        persisted so pollers see the outcome."""
+        from ..api.types import History
+
+        task.status = JobStateEnum.FAILED
+        with self._lock:
+            self._jobs.pop(task.job_id, None)
+        self.history_store.save(
+            History(id=task.job_id,
+                    task={"request": task.parameters.to_dict(), "error": str(error)})
+        )
+
     # --- standalone mode (reference: ps/job_pod.go + train/client) ---
 
     def _start_standalone(self, task: TrainTask) -> None:
@@ -160,12 +172,7 @@ class ParameterServer:
 
         import requests
 
-        placeholder = _JobRecord(task=task, job=None, thread=None)
-        with self._lock:
-            if task.job_id in self._jobs:
-                raise KubeMLError(f"job {task.job_id} already exists", 400)
-            self._jobs[task.job_id] = placeholder
-            self._serving_cache.pop(task.job_id, None)
+        placeholder = self._reserve_slot(task)
         try:
             env = dict(
                 __import__("os").environ,
@@ -218,19 +225,61 @@ class ParameterServer:
                     f"could not start job {task.job_id} on its runner: {last}", 500
                 )
         except Exception as e:
-            task.status = JobStateEnum.FAILED
-            with self._lock:
-                self._jobs.pop(task.job_id, None)
-            from ..api.types import History
-
-            self.history_store.save(
-                History(id=task.job_id,
-                        task={"request": task.parameters.to_dict(), "error": str(e)})
-            )
+            self._fail_start(task, e)
             raise
         task.status = JobStateEnum.RUNNING
         self.metrics.task_started("train")
+        self._ensure_monitor()
         log.info("standalone job %s running at %s (pid %d)", task.job_id, url, proc.pid)
+
+    def _handle_runner_death(self, job_id: str, record: _JobRecord) -> None:
+        """Cleanup after a runner died without its /finish callback (crash,
+        OOM-kill): fail the task, persist a history record (completion pollers
+        key off it), and tear down — guarded against stale records."""
+        with self._lock:
+            if self._jobs.get(job_id) is not record:
+                return  # already finished, or the id now belongs to a new job
+        log.error("standalone job %s runner exited (code %s) without reporting; "
+                  "marking failed", job_id, record.proc.returncode)
+        record.task.status = JobStateEnum.FAILED
+        try:
+            self.history_store.get(job_id)  # runner may have saved one
+        except Exception:
+            from ..api.types import History
+
+            self.history_store.save(History(
+                id=job_id,
+                task={"request": record.task.parameters.to_dict(),
+                      "error": f"job runner exited with code {record.proc.returncode}"},
+            ))
+        self._finish(job_id, expect=record)
+
+    def _ensure_monitor(self) -> None:
+        """A liveness monitor for standalone runners (the reference's pod
+        watch): any record whose process died without reporting is cleaned up
+        even when nothing is blocked in wait()."""
+        with self._lock:
+            if self._monitor is not None and self._monitor.is_alive():
+                return
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="ps-runner-monitor", daemon=True
+            )
+            self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        while True:
+            time.sleep(2.0)
+            with self._lock:
+                live = [(jid, r) for jid, r in self._jobs.items() if r.proc is not None]
+            if not live:
+                # no standalone jobs left: let the thread retire (a new job
+                # re-arms it via _ensure_monitor)
+                with self._lock:
+                    self._monitor = None
+                return
+            for jid, record in live:
+                if record.proc.poll() is not None:
+                    self._handle_runner_death(jid, record)
 
     @staticmethod
     def _drain_runner_output(job_id: str, stream) -> None:
@@ -285,9 +334,19 @@ class ParameterServer:
         finally:
             self._finish(task.job_id)
 
-    def _finish(self, job_id: str) -> None:
+    def _finish(self, job_id: str, expect: Optional[_JobRecord] = None) -> bool:
         """Job teardown (reference api.go:266-327): clear metrics, notify the
-        scheduler, drop the index entry."""
+        scheduler, drop the index entry.
+
+        ``expect`` guards against acting on a stale record: when the slot now
+        holds a different record (same id resubmitted), nothing is torn down —
+        otherwise a late crash-detector would kill the live replacement job
+        and double-decrement the running gauge."""
+        with self._lock:
+            record = self._jobs.get(job_id)
+            if record is None or (expect is not None and record is not expect):
+                return False
+            self._jobs.pop(job_id, None)
         self.metrics.clear(job_id)
         self.metrics.task_finished("train")
         if self.scheduler is not None:
@@ -295,11 +354,10 @@ class ParameterServer:
                 self.scheduler.finish_job(job_id)
             except Exception:
                 log.exception("notifying scheduler of %s finish failed", job_id)
-        with self._lock:
-            record = self._jobs.pop(job_id, None)
-        if record is not None and record.update_box is not None:
+        if record.update_box is not None:
             # unblock a job thread stuck waiting for a scheduler answer
             record.update_box.event.set()
+        return True
 
     # --- elastic round-trip ---
 
@@ -393,28 +451,10 @@ class ParameterServer:
             deadline = time.time() + (timeout if timeout is not None else 3600.0)
             while time.time() < deadline:
                 with self._lock:
-                    if job_id not in self._jobs:
-                        return True
+                    if self._jobs.get(job_id) is not record:
+                        return True  # finished (or the id was reused — not ours)
                 if record.proc.poll() is not None:
-                    # runner died without its finish callback (crash/kill):
-                    # fail the task, persist a history record (every other
-                    # failure path does — completion pollers key off it), and
-                    # clean up so nothing waits forever
-                    log.error("standalone job %s runner exited (code %s) without "
-                              "reporting; marking failed", job_id, record.proc.returncode)
-                    record.task.status = JobStateEnum.FAILED
-                    try:
-                        self.history_store.get(job_id)  # runner may have saved one
-                    except Exception:
-                        from ..api.types import History
-
-                        self.history_store.save(History(
-                            id=job_id,
-                            task={"request": record.task.parameters.to_dict(),
-                                  "error": f"job runner exited with code "
-                                           f"{record.proc.returncode}"},
-                        ))
-                    self._finish(job_id)
+                    self._handle_runner_death(job_id, record)
                     return True
                 time.sleep(0.1)
             return False

@@ -1,0 +1,86 @@
+"""Multi-host distributed helpers (single-process behavior + layout math) and
+deploy asset sanity."""
+
+import json
+from pathlib import Path
+
+import jax
+import pytest
+
+from kubeml_tpu.parallel.distributed import (
+    global_mesh,
+    init_distributed,
+    local_batch_slice,
+    num_slices,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_init_distributed_single_process_noop(monkeypatch):
+    monkeypatch.delenv("KUBEML_COORDINATOR", raising=False)
+    monkeypatch.delenv("KUBEML_NUM_PROCESSES", raising=False)
+    assert init_distributed() is False
+    # still a working single-process jax
+    assert jax.process_count() == 1
+
+
+def test_num_slices_cpu_is_one():
+    assert num_slices() == 1
+
+
+def test_global_mesh_single_slice_fallback():
+    mesh = global_mesh(tp=2, sp=2)
+    assert mesh.shape["tp"] == 2 and mesh.shape["sp"] == 2
+    assert mesh.shape["dp"] == len(jax.devices()) // 4
+    # all global devices accounted for
+    assert mesh.devices.size == len(jax.devices())
+
+
+def test_local_batch_slice_single_process():
+    start, end = local_batch_slice(64)
+    assert (start, end) == (0, 64)
+
+
+def test_global_mesh_rejects_bad_model_factor():
+    # model axes exceeding the device count must fail loudly via mesh_shape_for
+    with pytest.raises(ValueError):
+        global_mesh(tp=64)
+
+
+# --- deploy assets ---
+
+
+def test_grafana_dashboard_parses_and_covers_reference_panels():
+    d = json.loads((REPO / "deploy/grafana/kubeml-dashboard.json").read_text())
+    titles = {p["title"] for p in d["panels"]}
+    assert {"Running jobs", "Train loss", "Validation loss",
+            "Validation accuracy (%)", "Parallelism", "Epoch duration (s)"} <= titles
+    exprs = [t["expr"] for p in d["panels"] for t in p["targets"]]
+    for metric in ("kubeml_job_train_loss", "kubeml_job_validation_loss",
+                   "kubeml_job_validation_accuracy", "kubeml_job_parallelism",
+                   "kubeml_job_epoch_duration_seconds", "kubeml_job_running_total"):
+        assert any(metric in e for e in exprs), metric
+
+
+def test_dashboard_metrics_exist_in_registry():
+    """Every metric the dashboard queries is one the PS actually exports."""
+    from kubeml_tpu.ps.metrics import MetricsRegistry
+    from kubeml_tpu.api.types import MetricUpdate
+
+    reg = MetricsRegistry()
+    reg.task_started("train")
+    reg.update(MetricUpdate(job_id="j", train_loss=1.0, validation_loss=2.0,
+                            accuracy=50.0, parallelism=2, epoch_duration=1.5))
+    text = reg.render()
+    d = json.loads((REPO / "deploy/grafana/kubeml-dashboard.json").read_text())
+    for p in d["panels"]:
+        for t in p["targets"]:
+            name = t["expr"].split("{")[0].replace("sum(", "").rstrip(")")
+            assert name in text, f"dashboard queries unknown metric {name}"
+
+
+def test_prometheus_and_systemd_assets_exist():
+    assert (REPO / "deploy/prometheus.yml").read_text().strip()
+    unit = (REPO / "deploy/systemd/kubeml.service").read_text()
+    assert "kubeml_tpu.cli start" in unit

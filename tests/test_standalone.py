@@ -144,6 +144,53 @@ def test_standalone_elastic_roundtrip(standalone_cluster):
     assert max(hist.parallelism) > 1, hist.parallelism
 
 
+def test_monitor_detects_killed_runner(standalone_cluster):
+    """kill -9 on a runner: the PS liveness monitor (not wait()) fails the
+    task, persists an error history, and frees the job id for resubmission."""
+    cluster = standalone_cluster
+    from kubeml_tpu.api.types import TrainOptions, TrainRequest
+
+    req = TrainRequest(
+        function_name="tiny", dataset="blobs", epochs=99, batch_size=16, lr=0.05,
+        options=TrainOptions(default_parallelism=2, static_parallelism=True,
+                             k=2, precision="f32"),
+    )
+    job_id = cluster.scheduler.submit_train(req)
+    t0 = time.time()
+    rec = None
+    while time.time() - t0 < 120:
+        with cluster.ps._lock:
+            rec = cluster.ps._jobs.get(job_id)
+        # wait until /start was delivered (status RUNNING) so the kill hits a
+        # live training job, not the startup handshake
+        if rec is not None and rec.proc is not None and rec.task.status == "running":
+            break
+        time.sleep(0.2)
+    assert rec is not None and rec.proc is not None and rec.task.status == "running"
+    rec.proc.kill()  # SIGKILL: no finish callback will ever arrive
+
+    # the monitor thread cleans up without anyone calling ps.wait()
+    t0 = time.time()
+    while time.time() - t0 < 60:
+        with cluster.ps._lock:
+            if job_id not in cluster.ps._jobs:
+                break
+        time.sleep(0.5)
+    with cluster.ps._lock:
+        assert job_id not in cluster.ps._jobs, "monitor did not reap the dead runner"
+    hist = cluster.history_store.get(job_id)
+    assert "exited with code" in (hist.task or {}).get("error", "")
+    # the id is free again (scheduler active-ids released)
+    assert cluster.scheduler.submit_train(
+        TrainRequest(function_name="tiny", dataset="blobs", epochs=1, batch_size=16,
+                     lr=0.05, job_id=job_id,
+                     options=TrainOptions(default_parallelism=1,
+                                          static_parallelism=True, k=2,
+                                          precision="f32"))
+    ) == job_id
+    assert _wait_done(cluster, job_id)
+
+
 def test_runner_http_surface(tmp_config):
     """The runner's HTTP API in-process: /state before start, duplicate /start."""
     from kubeml_tpu.engine.job_runner import JobRunner
