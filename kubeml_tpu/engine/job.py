@@ -298,11 +298,17 @@ class TrainJob:
                     continue
             with self.tracer.span("job.round", job=self.job_id, epoch=epoch,
                                   round=rb.round_index):
+                # async-stage the slabs (bf16 host cast + device_put): the
+                # transfer rides the DMA engine while the previous round's
+                # compute is still in flight
+                sx, sy, sm = self.trainer.stage_round(
+                    rb.x, rb.y, rb.mask, self.parallelism
+                )
                 self._stacked_vars, loss = self.trainer.sync_round(
                     self._stacked_vars,
-                    rb.x,
-                    rb.y,
-                    rb.mask,
+                    sx,
+                    sy,
+                    sm,
                     jax.random.fold_in(rng, rb.round_index),
                     lr=req.lr,
                     epoch=epoch,

@@ -125,6 +125,29 @@ class KAvgTrainer:
             return x.astype(jnp.bfloat16)
         return x
 
+    def stage_round(self, batch_x, batch_y, mask, n_workers: int):
+        """Asynchronously stage one round's slabs onto the worker mesh.
+
+        Host-casts f32 samples to bf16 first (native multithreaded pass —
+        halves the host->HBM bytes), then ``jax.device_put``s with the worker
+        sharding; the transfer overlaps the previous round's compute because
+        nothing here blocks. Returns (x, y, mask) accepted by sync_round."""
+        sharded, _ = self._shardings(n_workers)
+        x = batch_x
+        if (
+            self.precision == "bf16"
+            and isinstance(x, np.ndarray)
+            and x.dtype == np.float32
+        ):
+            from ..native import f32_to_bf16
+
+            x = f32_to_bf16(x)
+        return (
+            jax.device_put(x, sharded),
+            jax.device_put(batch_y, sharded),
+            jax.device_put(mask, sharded),
+        )
+
     # --- lifecycle ---
 
     def init_variables(self, rng: jax.Array, sample_x: np.ndarray, n_workers: int):
@@ -264,7 +287,10 @@ class KAvgTrainer:
         # epoch enters the key only for models whose optimizer schedule reads it
         # (KubeModel.epoch_in_schedule); otherwise one executable serves all epochs
         epoch_key = int(epoch) if self.model.epoch_in_schedule else 0
-        key = (n, steps, batch_x.shape[2:], batch_y.shape[2:], float(lr), epoch_key)
+        # dtype is part of the key: staged rounds arrive pre-cast to bf16 while
+        # unstaged ones are f32, and the two trace differently
+        key = (n, steps, batch_x.shape[2:], str(batch_x.dtype),
+               batch_y.shape[2:], str(batch_y.dtype), float(lr), epoch_key)
         fn = self._train_cache.get(key)
         if fn is None:
             fn = self._build_sync_round(n, steps, float(lr), int(epoch))

@@ -9,6 +9,7 @@ from .bindings import (  # noqa: F401
     TensorClient,
     TensorServer,
     TensorStore,
+    f32_to_bf16,
     get_lib,
     native_available,
     pack_rounds,
@@ -18,6 +19,7 @@ __all__ = [
     "TensorClient",
     "TensorServer",
     "TensorStore",
+    "f32_to_bf16",
     "get_lib",
     "native_available",
     "pack_rounds",

@@ -42,6 +42,10 @@ def _bind(path) -> ctypes.CDLL:
         ctypes.c_int32,
     ]
     lib.kml_pack.restype = None
+    lib.kml_f32_to_bf16.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+    ]
+    lib.kml_f32_to_bf16.restype = None
     lib.kml_store_new.restype = ctypes.c_int64
     lib.kml_store_free.argtypes = [ctypes.c_int64]
     lib.kml_store_set.argtypes = [
@@ -167,6 +171,26 @@ def pack_rounds(
             dst[w, :c] = s[:c]
         if c < per_round:
             dst[w, c:] = 0
+
+
+def f32_to_bf16(x: np.ndarray, n_threads: int = 0) -> np.ndarray:
+    """Round-to-nearest-even f32 -> bf16 cast on the host (halves host->HBM
+    transfer bytes for bf16 training). Native multithreaded pass when built;
+    ml_dtypes astype otherwise."""
+    import ml_dtypes
+
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    lib = get_lib(block=False)
+    if lib is None:
+        return x.astype(ml_dtypes.bfloat16)
+    out = np.empty(x.shape, dtype=ml_dtypes.bfloat16)
+    if n_threads <= 0:
+        n_threads = os.cpu_count() or 1
+    lib.kml_f32_to_bf16(
+        x.ctypes.data_as(ctypes.c_void_p), out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(x.size), ctypes.c_int32(n_threads),
+    )
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -328,6 +328,38 @@ void kml_pack(uint8_t* dst, const uint8_t* const* srcs, const int64_t* counts,
   for (auto& t : ts) t.join();
 }
 
+// f32 -> bf16 (round-to-nearest-even), multithreaded. The host-side cast that
+// halves host->HBM transfer bytes for bf16 training; numpy's ml_dtypes cast is
+// scalar-slow, this is a linear pass.
+static inline uint16_t f32_to_bf16_rne(uint32_t bits) {
+  // NaN must stay NaN (quiet); otherwise round to nearest even on bit 16
+  if ((bits & 0x7fffffffu) > 0x7f800000u) return static_cast<uint16_t>((bits >> 16) | 0x0040u);
+  uint32_t rounding_bias = 0x7fffu + ((bits >> 16) & 1u);
+  return static_cast<uint16_t>((bits + rounding_bias) >> 16);
+}
+
+static void cast_range(const uint32_t* src, uint16_t* dst, int64_t lo, int64_t hi) {
+  for (int64_t i = lo; i < hi; ++i) dst[i] = f32_to_bf16_rne(src[i]);
+}
+
+void kml_f32_to_bf16(const float* src, uint16_t* dst, int64_t n,
+                     int32_t n_threads) {
+  const uint32_t* s = reinterpret_cast<const uint32_t*>(src);
+  if (n_threads < 1) n_threads = 1;
+  if (n < (1 << 16) || n_threads == 1) {
+    cast_range(s, dst, 0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t per = (n + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * per, hi = std::min(n, lo + per);
+    if (lo >= hi) break;
+    ts.emplace_back(cast_range, s, dst, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
 // --- tensor store (in-process) ---
 
 int64_t kml_store_new() {

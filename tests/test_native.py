@@ -76,6 +76,83 @@ def test_pack_dtype_mismatch_falls_back(rng):
     np.testing.assert_allclose(dst[0], src.astype(np.float64))
 
 
+# --- f32 -> bf16 cast kernel ---
+
+
+def test_f32_to_bf16_matches_mldtypes(rng):
+    import ml_dtypes
+
+    from kubeml_tpu.native import f32_to_bf16
+
+    # large enough to cross the 1<<16 multithreading threshold, and explicitly
+    # multithreaded so the chunk-split bounds are exercised bit-exactly
+    x = rng.normal(scale=100.0, size=(1 << 17) + 771).astype(np.float32)
+    # include specials: denormals, inf, nan, negative zero
+    x[:6] = [0.0, -0.0, np.inf, -np.inf, np.nan, 1e-40]
+    for threads in (1, 4):
+        got = f32_to_bf16(x, n_threads=threads)
+        ref = x.astype(ml_dtypes.bfloat16)
+        assert got.dtype == ref.dtype
+        np.testing.assert_array_equal(
+            got.view(np.uint16)[~np.isnan(x)], ref.view(np.uint16)[~np.isnan(x)]
+        )
+        assert np.isnan(got.astype(np.float32)[np.isnan(x)]).all()
+
+
+def test_stage_round_matches_unstaged(tmp_config, rng):
+    """bf16-staged rounds must train to the same weights as the jit-cast path."""
+    import jax
+    import optax
+    import flax.linen as nn
+
+    from kubeml_tpu.engine.kavg import KAvgTrainer
+    from kubeml_tpu.runtime.model import KubeModel
+    from kubeml_tpu.data.dataset import KubeDataset
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+    class Ds(KubeDataset):
+        def __init__(self):
+            super().__init__("unused")
+
+    class M(KubeModel):
+        def __init__(self):
+            super().__init__(Ds())
+
+        def build(self):
+            return Tiny()
+
+        def configure_optimizers(self):
+            return optax.sgd(0.1)
+
+    n, k, b = 2, 2, 4
+    x = rng.normal(size=(n, k, b, 3)).astype(np.float32)
+    y = rng.integers(0, 4, size=(n, k, b)).astype(np.int64)
+    mask = np.ones((n, k, b), np.float32)
+    results = []
+    for staged in (False, True):
+        trainer = KAvgTrainer(M(), precision="bf16")
+        variables = trainer.init_variables(jax.random.PRNGKey(0), x[0, 0], n)
+        if staged:
+            sx, sy, sm = trainer.stage_round(x, y, mask, n)
+            variables, loss = trainer.sync_round(variables, sx, sy, sm,
+                                                 jax.random.PRNGKey(1), lr=0.1)
+        else:
+            variables, loss = trainer.sync_round(variables, x, y, mask,
+                                                 jax.random.PRNGKey(1), lr=0.1)
+        results.append((trainer.reference_variables(variables), float(loss)))
+    (va, la), (vb, lb) = results
+    assert abs(la - lb) < 1e-3
+    import jax as _jax
+
+    for a, b_ in zip(_jax.tree.leaves(va), _jax.tree.leaves(vb)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b_, np.float32), atol=2e-2)
+
+
 # --- TensorStore ---
 
 
