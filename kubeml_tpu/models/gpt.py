@@ -31,9 +31,18 @@ def _part(names):
 class CausalSelfAttention(nn.Module):
     num_heads: int
     mesh: Optional[Mesh] = None
+    # sequence-parallel scheme when mesh.sp > 1: "ring" (ppermute K/V rotation,
+    # kubeml_tpu.parallel.ring) or "ulysses" (head<->sequence all_to_all,
+    # kubeml_tpu.parallel.ulysses — needs the per-tp-shard head count,
+    # num_heads/tp, divisible by sp)
+    sp_impl: str = "ring"
 
     @nn.compact
     def __call__(self, x, valid):
+        if self.sp_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"unknown sp_impl {self.sp_impl!r} (valid: 'ring', 'ulysses')"
+            )
         B, L, E = x.shape
         H = self.num_heads
         D = E // H
@@ -51,10 +60,18 @@ class CausalSelfAttention(nn.Module):
         out_proj = dense(E, ("tp", None), "proj")
 
         if self.mesh is not None and self.mesh.shape.get("sp", 1) > 1:
-            attn = jax.shard_map(
-                lambda q, k, v, val: ring_attention(
+            if self.sp_impl == "ulysses":
+                from ..parallel.ulysses import ulysses_attention
+
+                sp_fn = lambda q, k, v, val: ulysses_attention(
                     q, k, v, axis_name="sp", causal=True, kv_valid=val
-                ),
+                )
+            else:
+                sp_fn = lambda q, k, v, val: ring_attention(
+                    q, k, v, axis_name="sp", causal=True, kv_valid=val
+                )
+            attn = jax.shard_map(
+                sp_fn,
                 mesh=self.mesh,
                 in_specs=(
                     P("dp", "sp", "tp", None),
@@ -76,11 +93,13 @@ class GPTBlock(nn.Module):
     mlp_ratio: int = 4
     dropout: float = 0.0
     mesh: Optional[Mesh] = None
+    sp_impl: str = "ring"
 
     @nn.compact
     def __call__(self, x, valid, train: bool = False):
         y = nn.LayerNorm(name="ln1")(x)
-        y = CausalSelfAttention(self.num_heads, mesh=self.mesh, name="attn")(y, valid)
+        y = CausalSelfAttention(self.num_heads, mesh=self.mesh,
+                                sp_impl=self.sp_impl, name="attn")(y, valid)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(name="ln2")(x)
@@ -110,6 +129,7 @@ class CausalTransformer(nn.Module):
     mlp_ratio: int = 4
     dropout: float = 0.0
     mesh: Optional[Mesh] = None
+    sp_impl: str = "ring"  # sequence-parallel scheme: "ring" | "ulysses"
     # --- MoE interleaving ---
     moe_every: int = 0
     num_experts: int = 8
@@ -132,10 +152,12 @@ class CausalTransformer(nn.Module):
 
                 x = MoEBlock(self.num_heads, self.num_experts, self.mlp_ratio,
                              self.top_k, self.dropout, mesh=self.mesh,
+                             sp_impl=self.sp_impl,
                              name=f"block_{i}")(x, valid, train=train)
             else:
                 x = GPTBlock(self.num_heads, self.mlp_ratio, self.dropout,
-                             mesh=self.mesh, name=f"block_{i}")(x, valid, train=train)
+                             mesh=self.mesh, sp_impl=self.sp_impl,
+                             name=f"block_{i}")(x, valid, train=train)
         x = nn.LayerNorm(name="ln_f")(x)
         logits = nn.Dense(self.vocab_size, name="lm_head", use_bias=False,
                           kernel_init=_part((None, "tp"))(nn.initializers.lecun_normal()))(x)

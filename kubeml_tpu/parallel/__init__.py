@@ -20,6 +20,7 @@ from .mesh import make_mesh, mesh_shape_for
 from .moe import MoEBlock, MoEMlp, MoETiny, MoETransformer
 from .pipeline import PipelinedLM, PipelineTrainer, gpipe
 from .ring import ring_attention
+from .ulysses import ulysses_attention
 
 __all__ = [
     "global_mesh",
@@ -36,4 +37,5 @@ __all__ = [
     "make_mesh",
     "mesh_shape_for",
     "ring_attention",
+    "ulysses_attention",
 ]

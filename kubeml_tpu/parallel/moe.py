@@ -123,14 +123,16 @@ class MoEBlock(nn.Module):
     mlp_ratio: int = 4
     top_k: int = 2
     dropout: float = 0.0
-    mesh: Optional[object] = None  # jax.sharding.Mesh; for ring attention
+    mesh: Optional[object] = None  # jax.sharding.Mesh; for sp attention
+    sp_impl: str = "ring"
 
     @nn.compact
     def __call__(self, x, valid, train: bool = False):
         from ..models.gpt import CausalSelfAttention
 
         y = nn.LayerNorm(name="ln1")(x)
-        y = CausalSelfAttention(self.num_heads, mesh=self.mesh, name="attn")(y, valid)
+        y = CausalSelfAttention(self.num_heads, mesh=self.mesh,
+                                sp_impl=self.sp_impl, name="attn")(y, valid)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(name="ln2")(x)

@@ -77,6 +77,60 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out2), np.asarray(out8), atol=1e-5)
 
 
+def _ulysses(q, k, v, causal=False, kv_valid=None, sp=2):
+    from kubeml_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = make_mesh(sp=sp)
+    args = (q, k, v) if kv_valid is None else (q, k, v, kv_valid)
+    in_specs = tuple([P(None, "sp")] * 3 + ([P(None, "sp")] if kv_valid is not None else []))
+    fn = jax.shard_map(
+        lambda q, k, v, *val: ulysses_attention(
+            q, k, v, axis_name="sp", causal=causal, kv_valid=val[0] if val else None
+        ),
+        mesh=mesh, in_specs=in_specs, out_specs=P(None, "sp"), check_vma=False,
+    )
+    return jax.jit(fn)(*args)
+
+
+class TestUlyssesAttention:
+    """Head<->sequence all-to-all SP must be exact like the ring is."""
+
+    def setup_method(self, _):
+        r = np.random.default_rng(0)
+        B, L, H, D = 2, 16, 4, 8
+        self.q = jnp.asarray(r.normal(size=(B, L, H, D)).astype(np.float32))
+        self.k = jnp.asarray(r.normal(size=(B, L, H, D)).astype(np.float32))
+        self.v = jnp.asarray(r.normal(size=(B, L, H, D)).astype(np.float32))
+        self.L = L
+
+    def test_matches_full_attention(self):
+        out = _ulysses(self.q, self.k, self.v)
+        ref = dot_product_attention(self.q, self.k, self.v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_causal_matches_masked_full(self):
+        out = _ulysses(self.q, self.k, self.v, causal=True)
+        causal = (jnp.arange(self.L)[None, :] <= jnp.arange(self.L)[:, None])[None, None]
+        ref = dot_product_attention(self.q, self.k, self.v, mask=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_padding_mask(self):
+        r = np.random.default_rng(1)
+        valid = jnp.asarray(r.random((2, self.L)) > 0.3)
+        out = _ulysses(self.q, self.k, self.v, kv_valid=valid)
+        ref = dot_product_attention(self.q, self.k, self.v, mask=valid[:, None, None, :])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_matches_ring(self):
+        out_u = _ulysses(self.q, self.k, self.v, causal=True, sp=4)
+        out_r = _ring(self.q, self.k, self.v, causal=True, sp=4)
+        np.testing.assert_allclose(np.asarray(out_u), np.asarray(out_r), atol=1e-5)
+
+    def test_heads_not_divisible_raises(self):
+        with pytest.raises(Exception, match="divisible"):
+            _ulysses(self.q[:, :, :3], self.k[:, :, :3], self.v[:, :, :3], sp=2)
+
+
 class TestGPTParity:
     def test_ring_model_matches_plain_model(self):
         """The same weights must produce identical logits with sp ring attention
@@ -96,6 +150,29 @@ class TestGPTParity:
         ref = plain.apply(variables, ids, train=False)
         with jax.set_mesh(mesh):
             out = jax.jit(lambda v, x: ringed.apply(v, x, train=False))(variables, ids)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+    def test_ulysses_model_matches_plain_model(self):
+        """Same weights, ulysses SP -> identical logits to full attention."""
+        from kubeml_tpu.models.gpt import CausalTransformer
+
+        mesh = make_mesh(dp=2, sp=2, tp=2)
+        mk = lambda m: CausalTransformer(vocab_size=50, max_len=16, embed_dim=64,
+                                         depth=2, num_heads=4, mesh=m,
+                                         sp_impl="ulysses")
+        plain = CausalTransformer(vocab_size=50, max_len=16, embed_dim=64,
+                                  depth=2, num_heads=4)
+        sp_model = mk(mesh)
+        r = np.random.default_rng(0)
+        ids = jnp.asarray(
+            np.concatenate(
+                [r.integers(1, 50, size=(4, 12)), np.zeros((4, 4), int)], axis=1
+            ).astype(np.int32)
+        )
+        variables = plain.init(jax.random.PRNGKey(0), ids, train=False)
+        ref = plain.apply(variables, ids, train=False)
+        with jax.set_mesh(mesh):
+            out = jax.jit(lambda v, x: sp_model.apply(v, x, train=False))(variables, ids)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
 
 
