@@ -61,6 +61,34 @@ class Config:
         )
     )
     use_native_loader: bool = field(default_factory=lambda: _env_bool("KUBEML_NATIVE_LOADER", True))
+    # persistent XLA compilation cache: elastic re-meshes recompile per worker
+    # count and standalone job runners are fresh processes — both hit this disk
+    # cache instead of recompiling (SURVEY §7 "elastic parallelism vs XLA").
+    # Default on, under data_root; KUBEML_COMPILE_CACHE=0 disables, or set a path.
+    compile_cache: str = field(
+        default_factory=lambda: os.environ.get("KUBEML_COMPILE_CACHE", "1")
+    )
+
+    @property
+    def compile_cache_dir(self) -> Optional[Path]:
+        v = self.compile_cache.lower()  # match _env_bool's case handling
+        if v in ("0", "false", "no", ""):
+            return None
+        if v in ("1", "true", "yes"):
+            return self.data_root / "xla-cache"
+        return Path(self.compile_cache).expanduser()
+
+    def enable_compilation_cache(self) -> None:
+        """Point jax's persistent compilation cache at the configured dir
+        (idempotent; call at service/runner startup)."""
+        d = self.compile_cache_dir
+        if d is None:
+            return
+        import jax
+
+        d.mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(d))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     @property
     def datasets_dir(self) -> Path:

@@ -62,6 +62,7 @@ class LocalCluster:
         self.ps_api: Optional[PSAPI] = None
 
     def start(self) -> "LocalCluster":
+        self.cfg.enable_compilation_cache()
         self.scheduler.start()
         if self.serve_http:
             self.controller.start()

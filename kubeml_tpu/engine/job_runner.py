@@ -245,6 +245,10 @@ def main(argv=None) -> int:
         format=f"%(asctime)s job-{args.job_id} %(name)s %(levelname)s %(message)s",
     )
     _apply_platform_env()
+    from ..api.config import get_config
+
+    # fresh process: the persistent XLA cache turns the cold jit into a read
+    get_config().enable_compilation_cache()
     runner = JobRunner(args.job_id, port=args.port).start()
     # the parent reads this line to learn the bound port (job_pod readiness)
     print(f"LISTENING {runner.service.port}", flush=True)
