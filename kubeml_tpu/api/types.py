@@ -94,6 +94,10 @@ class TrainOptions(_JsonMixin):
     k: int = 16
     goal_accuracy: float = 100.0
     # --- TPU-native extensions ---
+    # training engine: "kavg" = reference-parity elastic local-SGD;
+    # "spmd" = synchronous multi-axis mesh training (transformers/LLMs —
+    # mesh_shape picks the axes, e.g. {"dp": 2, "sp": 2, "tp": 2})
+    engine: str = "kavg"
     precision: str = "bf16"  # compute dtype for matmul/conv (MXU native)
     mesh_shape: Optional[Dict[str, int]] = None  # explicit mesh override {axis: size}
     donate: bool = True  # donate params buffers into the jitted step
@@ -105,6 +109,8 @@ class TrainOptions(_JsonMixin):
     chaos_prob: float = 0.0  # per-worker per-round failure probability
 
     def __post_init__(self):
+        if self.engine not in ("kavg", "spmd"):
+            raise ValueError(f"engine must be 'kavg' or 'spmd', got {self.engine!r}")
         if self.validate_every < 0:
             raise ValueError("validate_every must be >= 0")
         if self.checkpoint_every < 0:

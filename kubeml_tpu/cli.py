@@ -45,6 +45,16 @@ def cmd_train(args) -> int:
               file=sys.stderr)
         return 1
     k = -1 if args.sparse_avg else args.k
+    mesh_shape = None
+    if args.mesh:
+        try:
+            mesh_shape = {
+                ax: int(size)
+                for ax, size in (kv.split("=") for kv in args.mesh.split(","))
+            }
+        except ValueError:
+            print("error: --mesh expects e.g. tp=2,sp=2", file=sys.stderr)
+            return 1
     req = TrainRequest(
         job_id=args.id or "",
         model_type=args.function,
@@ -63,6 +73,8 @@ def cmd_train(args) -> int:
             resume=args.resume,
             save_model=not args.no_save_model,
             chaos_prob=args.chaos_prob,
+            engine=args.engine,
+            mesh_shape=mesh_shape,
         ),
     )
     job_id = _client(args).networks().train(req)
@@ -125,6 +137,8 @@ def cmd_task(args) -> int:
     elif args.action == "stop":
         c.stop(args.id)
         print(f"stopped {args.id}")
+    elif args.action == "prune":
+        print(f"pruned {c.prune()} tasks")
     return 0
 
 
@@ -257,6 +271,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="skip the final model export")
     t.add_argument("--chaos-prob", type=float, default=0.0,
                    help="per-worker per-round failure injection probability")
+    t.add_argument("--engine", choices=["kavg", "spmd"], default="kavg",
+                   help="kavg = elastic local-SGD; spmd = multi-axis mesh (LLMs)")
+    t.add_argument("--mesh", default=None,
+                   help="spmd mesh axes, e.g. tp=2,sp=2 (rest of devices -> dp)")
     t.set_defaults(fn=cmd_train)
 
     i = sub.add_parser("infer", help="run inference against a trained job")
@@ -293,6 +311,7 @@ def build_parser() -> argparse.ArgumentParser:
     kl.add_argument("--short", action="store_true")
     ks = ksub.add_parser("stop")
     ks.add_argument("--id", required=True)
+    ksub.add_parser("prune")
     k.set_defaults(fn=cmd_task)
 
     h = sub.add_parser("history", help="training histories")

@@ -102,6 +102,9 @@ class _Tasks:
     def stop(self, job_id: str) -> None:
         _check(requests.delete(f"{self.c.url}/tasks/{job_id}", timeout=self.c.timeout))
 
+    def prune(self) -> int:
+        return _check(requests.delete(f"{self.c.url}/tasks", timeout=self.c.timeout))["pruned"]
+
 
 class _Histories:
     def __init__(self, client: "KubemlClient"):

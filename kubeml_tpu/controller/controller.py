@@ -53,6 +53,7 @@ class Controller:
         router.route("POST", "/dataset/{name}", self._dataset_create)
         router.route("DELETE", "/dataset/{name}", self._dataset_delete)
         router.route("GET", "/tasks", self._tasks)
+        router.route("DELETE", "/tasks", self._task_prune)
         router.route("DELETE", "/tasks/{id}", self._task_stop)
         router.route("GET", "/history", self._history_list)
         router.route("GET", "/history/{id}", self._history_get)
@@ -118,6 +119,9 @@ class Controller:
     def _task_stop(self, req: Request):
         self.ps.stop_task(req.params["id"])
         return {}
+
+    def _task_prune(self, req: Request):
+        return {"pruned": self.ps.prune_tasks()}
 
     # --- history (reference historyApi.go:14-111) ---
 

@@ -104,7 +104,12 @@ class JobRunner:
             request.options.default_parallelism = (
                 task.state.parallelism or request.options.default_parallelism
             )
-            self.job = TrainJob(
+            job_cls = TrainJob
+            if request.options.engine == "spmd":
+                from .spmd_job import SPMDJob
+
+                job_cls = SPMDJob
+            self.job = job_cls(
                 self.job_id, request, model,
                 store=ShardStore(config=self.cfg),
                 history_store=HistoryStore(config=self.cfg),

@@ -1,0 +1,283 @@
+"""SPMDJob — control-plane job driving the SPMD (multi-axis mesh) engine.
+
+The K-AVG job (engine/job.py) is the reference-parity path: elastic data
+parallelism with local SGD. This job is the TPU-native extension for models
+that need the full mesh — transformers/LLMs sharded over dp/tp/sp/ep — made
+reachable through the same control plane: ``kubeml train --engine spmd
+--mesh tp=2,sp=2`` deploys the same kind of function file, and datasets are
+token-id arrays ``[N, L]`` in the same shard store.
+
+Differences from the K-AVG job, by design:
+
+* parallelism is the mesh (fixed for the job's life): no elastic re-meshing,
+  no scheduler round-trip — ``JobState.parallelism`` reports the device count;
+* the objective is next-token LM loss (kubeml_tpu.parallel.trainer.lm_loss)
+  unless the model overrides ``per_sample_loss`` is irrelevant here — language
+  modeling trains on the tokens themselves, labels in the store are ignored;
+* validation reports eval loss (no accuracy — goal_accuracy does not apply).
+
+The user's ``build()`` may read ``self.mesh`` (set by this job before the
+module is built) to construct a mesh-aware module, e.g.
+``CausalTransformer(mesh=self.mesh, sp_impl="ulysses")``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..api.errors import KubeMLError
+from ..api.types import History, JobState, MetricUpdate, TrainRequest
+from ..parallel.mesh import make_mesh, mesh_shape_for
+from ..parallel.trainer import SPMDTrainer
+from ..storage.checkpoint import FINAL_TAG, CheckpointStore
+from ..storage.history import HistoryStore
+from ..storage.store import ShardStore
+from ..utils.tracing import get_tracer
+
+log = logging.getLogger("kubeml.spmdjob")
+
+
+class SPMDJob:
+    """Same lifecycle surface as TrainJob (train/stop/state/infer) over the
+    SPMD engine."""
+
+    def __init__(
+        self,
+        job_id: str,
+        request: TrainRequest,
+        model,
+        store: Optional[ShardStore] = None,
+        history_store: Optional[HistoryStore] = None,
+        checkpoint_store: Optional[CheckpointStore] = None,
+        on_epoch_end=None,  # accepted for TrainJob interface parity; unused
+        on_metrics=None,
+        devices=None,
+        seed: int = 0,
+    ):
+        self.job_id = job_id
+        self.request = request
+        self.model = model
+        self.store = store or ShardStore()
+        self.history_store = history_store or HistoryStore()
+        self._checkpoint_store = checkpoint_store
+        self.on_metrics = on_metrics
+        self.seed = seed
+        self.tracer = get_tracer()
+
+        devices = list(devices if devices is not None else jax.devices())
+        shape = mesh_shape_for(len(devices), **(request.options.mesh_shape or {}))
+        self.mesh = make_mesh(shape=shape, devices=devices)
+        # the user's build() may read self.mesh to construct a mesh-aware module
+        model.mesh = self.mesh
+        self.trainer = SPMDTrainer(
+            model.module,
+            self.mesh,
+            optimizer=model.configure_optimizers(),
+            precision=request.options.precision,
+            donate=request.options.donate,
+        )
+
+        self.history = History(id=job_id, task={"request": request.to_dict()})
+        self.stop_event = threading.Event()
+        self.exit_error: Optional[str] = None
+        # live inference and a donating train step must not touch the same
+        # buffers concurrently (donation invalidates the inputs)
+        self._step_lock = threading.Lock()
+
+    # --- TrainJob surface ---
+
+    def stop(self) -> None:
+        self.stop_event.set()
+
+    @property
+    def state(self) -> JobState:
+        return JobState(parallelism=self.mesh.devices.size)
+
+    @property
+    def checkpoint_store(self) -> CheckpointStore:
+        if self._checkpoint_store is None:
+            self._checkpoint_store = CheckpointStore()
+        return self._checkpoint_store
+
+    # --- data ---
+
+    def _token_batches(self, split: str, batch: int):
+        """Global [batch, L] token slabs; remainder rows beyond a dp-divisible
+        batch are dropped (SPMD batches must tile the dp axis)."""
+        handle = self.store.get(self.request.dataset)
+        n = handle.num_samples(split)
+        x = handle._load(split, "data")
+        dp = int(self.mesh.shape.get("dp", 1))
+        batch = max(dp, (batch // dp) * dp)
+        for a in range(0, n - batch + 1, batch):
+            yield np.ascontiguousarray(x[a : a + batch]).astype(np.int32)
+
+    # --- main loop ---
+
+    def train(self) -> History:
+        req = self.request
+        opts = req.options
+        try:
+            first = next(self._token_batches("train", req.batch_size), None)
+            if first is None:
+                raise KubeMLError(
+                    f"dataset {req.dataset!r} has fewer than one dp-divisible "
+                    f"batch of {req.batch_size}"
+                )
+            rng = jax.random.PRNGKey(self.seed)
+            self.trainer.init(rng, first)
+            log.info("%s: SPMD job on mesh %s", self.job_id, dict(self.mesh.shape))
+
+            start_epoch = 0
+            if opts.resume:
+                start_epoch = self._restore_latest()
+
+            for epoch in range(start_epoch, req.epochs):
+                if self.stop_event.is_set():
+                    break
+                t0 = time.time()
+                losses = []
+                with self.tracer.span("job.epoch", job=self.job_id, epoch=epoch,
+                                      engine="spmd"):
+                    for i, batch in enumerate(self._token_batches("train", req.batch_size)):
+                        if self.stop_event.is_set():
+                            break
+                        step_rng = jax.random.fold_in(rng, epoch * 100003 + i)
+                        with self._step_lock:
+                            losses.append(self.trainer.train_step(batch, step_rng))
+                if not losses:
+                    break  # stopped mid-epoch
+                train_loss = float(np.mean([float(l) for l in losses]))
+                elapsed = time.time() - t0
+
+                val_loss = None
+                if opts.validate_every > 0 and (epoch + 1) % opts.validate_every == 0:
+                    val_loss = self._validate()
+
+                self.history.append_epoch(
+                    train_loss=train_loss,
+                    parallelism=self.mesh.devices.size,
+                    duration=elapsed,
+                    validation_loss=val_loss,
+                )
+                self._push_metrics(train_loss, val_loss, elapsed)
+                log.info("%s: epoch %d/%d loss=%.4f val=%s %.2fs", self.job_id,
+                         epoch + 1, req.epochs, train_loss,
+                         f"{val_loss:.4f}" if val_loss is not None else "-", elapsed)
+                if opts.checkpoint_every > 0 and (epoch + 1) % opts.checkpoint_every == 0:
+                    self._save_checkpoint(epoch)
+
+            if opts.save_model and self.history.train_loss:
+                self.checkpoint_store.save(
+                    self.job_id, self._host_params(),
+                    epoch=len(self.history.train_loss), tag=FINAL_TAG,
+                    meta={"request": req.to_dict(), "history": self._history_lists()},
+                )
+        except KubeMLError as e:
+            self.exit_error = e.message
+            raise
+        except Exception as e:
+            self.exit_error = str(e)
+            raise KubeMLError(f"job {self.job_id} failed: {e}") from e
+        finally:
+            if self.exit_error is not None and isinstance(self.history.task, dict):
+                self.history.task["error"] = self.exit_error
+            self.history_store.save(self.history)
+        return self.history
+
+    # --- internals ---
+
+    def _restore_latest(self) -> int:
+        """Restore the newest checkpoint (epoch or final) into the sharded
+        params, continuing at the recorded epoch. Optimizer state restarts —
+        consistent with the K-AVG engine's per-sync optimizer reset."""
+        import flax.core.meta as meta
+
+        store = self.checkpoint_store
+        tags = store.tags(self.job_id)
+        if not tags:
+            return 0
+        best = None  # (start_epoch, Checkpoint)
+        last = store.latest_epoch(self.job_id)
+        if last is not None:
+            best = (last + 1, store.restore(self.job_id, epoch=last))
+        if FINAL_TAG in tags:
+            ck_final = store.restore(self.job_id, tag=FINAL_TAG)
+            if best is None or ck_final.epoch > best[0]:
+                best = (ck_final.epoch, ck_final)
+        start_epoch, ck = best
+        unboxed = meta.unbox(self.trainer.params)
+        shardings = jax.tree.map(lambda x: x.sharding, unboxed)
+        placed = jax.device_put(ck.variables, shardings)
+        self.trainer.params = meta.replace_boxed(self.trainer.params, placed)
+        for key, vals in ck.meta.get("history", {}).items():
+            if hasattr(self.history, key):
+                getattr(self.history, key).extend(vals)
+        log.info("%s: resumed from checkpoint %s (epoch %d)", self.job_id,
+                 ck.tag, start_epoch)
+        return start_epoch
+
+    def _validate(self) -> Optional[float]:
+        vals = []
+        with self.tracer.span("job.validate", job=self.job_id, engine="spmd"):
+            with jax.set_mesh(self.mesh):
+                for batch in self._token_batches("test", self.request.batch_size):
+                    vals.append(self.trainer.eval_loss(batch))
+        return float(np.mean(vals)) if vals else None
+
+    def _host_params(self):
+        import flax.linen as nn
+
+        return jax.tree.map(np.asarray, nn.meta.unbox(self.trainer.params))
+
+    def _history_lists(self) -> dict:
+        h = self.history
+        return {
+            "train_loss": list(h.train_loss),
+            "validation_loss": list(h.validation_loss),
+            "accuracy": list(h.accuracy),
+            "parallelism": list(h.parallelism),
+            "epoch_duration": list(h.epoch_duration),
+        }
+
+    def _save_checkpoint(self, epoch: int) -> None:
+        try:
+            with self.tracer.span("job.checkpoint", job=self.job_id, epoch=epoch):
+                self.checkpoint_store.save(
+                    self.job_id, self._host_params(), epoch=epoch,
+                    meta={"request": self.request.to_dict(),
+                          "history": self._history_lists()},
+                )
+        except Exception:
+            log.exception("%s: checkpoint save failed (non-fatal)", self.job_id)
+
+    def _push_metrics(self, train_loss, val_loss, elapsed) -> None:
+        if self.on_metrics is None:
+            return
+        try:
+            self.on_metrics(MetricUpdate(
+                job_id=self.job_id, train_loss=float(train_loss),
+                validation_loss=float(val_loss) if val_loss is not None else 0.0,
+                accuracy=0.0, parallelism=self.mesh.devices.size,
+                epoch_duration=float(elapsed),
+            ))
+        except Exception:
+            log.exception("%s: metrics push failed (non-fatal)", self.job_id)
+
+    def infer(self, x: np.ndarray):
+        """Greedy next-token ids for each position of the given token batch."""
+        if self.trainer.params is None:
+            raise KubeMLError(f"job {self.job_id} has no model yet", 400)
+        import jax.numpy as jnp
+
+        with self._step_lock, jax.set_mesh(self.mesh):
+            logits = self.model.module.apply(
+                self.trainer.params, jnp.asarray(np.asarray(x), jnp.int32), train=False
+            )
+            return np.asarray(jnp.argmax(logits, axis=-1))

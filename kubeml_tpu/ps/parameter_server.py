@@ -117,7 +117,12 @@ class ParameterServer:
             req.options.default_parallelism = (
                 task.state.parallelism or req.options.default_parallelism
             )
-            job = TrainJob(
+            job_cls = TrainJob
+            if req.options.engine == "spmd":
+                from ..engine.spmd_job import SPMDJob
+
+                job_cls = SPMDJob
+            job = job_cls(
                 task.job_id,
                 req,
                 model,
@@ -232,13 +237,14 @@ class ParameterServer:
         self._ensure_monitor()
         log.info("standalone job %s running at %s (pid %d)", task.job_id, url, proc.pid)
 
-    def _handle_runner_death(self, job_id: str, record: _JobRecord) -> None:
+    def _handle_runner_death(self, job_id: str, record: _JobRecord) -> bool:
         """Cleanup after a runner died without its /finish callback (crash,
         OOM-kill): fail the task, persist a history record (completion pollers
-        key off it), and tear down — guarded against stale records."""
+        key off it), and tear down — guarded against stale records. Returns
+        whether this call actually performed the teardown."""
         with self._lock:
             if self._jobs.get(job_id) is not record:
-                return  # already finished, or the id now belongs to a new job
+                return False  # already finished, or the id belongs to a new job
         log.error("standalone job %s runner exited (code %s) without reporting; "
                   "marking failed", job_id, record.proc.returncode)
         record.task.status = JobStateEnum.FAILED
@@ -252,7 +258,7 @@ class ParameterServer:
                 task={"request": record.task.parameters.to_dict(),
                       "error": f"job runner exited with code {record.proc.returncode}"},
             ))
-        self._finish(job_id, expect=record)
+        return self._finish(job_id, expect=record)
 
     def _ensure_monitor(self) -> None:
         """A liveness monitor for standalone runners (the reference's pod
@@ -311,6 +317,28 @@ class ParameterServer:
                 record.proc.kill()
 
         threading.Thread(target=reap, name="job-reaper", daemon=True).start()
+
+    def prune_tasks(self) -> int:
+        """`kubeml task prune` (reference cmd/task.go:62-117 deletes leaked job
+        pods/services): clean up records whose job thread or runner process is
+        dead but which never finished properly. Returns the count pruned."""
+        with self._lock:
+            candidates = list(self._jobs.items())
+        pruned = 0
+        for job_id, record in candidates:
+            if record.proc is not None and record.proc.poll() is not None:
+                if self._handle_runner_death(job_id, record):
+                    pruned += 1
+                continue
+            # thread.ident is None while assigned-but-not-started (start_task
+            # mid-flight) — that is a live job being born, not a leak
+            if (record.proc is None and record.thread is not None
+                    and record.thread.ident is not None
+                    and not record.thread.is_alive()):
+                record.task.status = JobStateEnum.FAILED
+                if self._finish(job_id, expect=record):
+                    pruned += 1
+        return pruned
 
     def shutdown_standalone_jobs(self) -> None:
         """Terminate any live job runner processes (cluster stop)."""

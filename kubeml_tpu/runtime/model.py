@@ -56,6 +56,9 @@ class KubeModel(ABC):
     def __init__(self, dataset: KubeDataset):
         self._dataset = dataset
         self._module = None
+        # set by the SPMD engine before build() so mesh-aware modules can read
+        # it (e.g. CausalTransformer(mesh=self.mesh)); None under K-AVG
+        self.mesh = None
         # per-invocation parameters, set by the runtime before any task runs
         # (the reference reads them from request args each call, network.py:91-97)
         self.lr: float = 0.01

@@ -1,0 +1,169 @@
+"""SPMD engine through the control plane: --engine spmd jobs (mesh-parallel
+LM training via the same function-deploy path as K-AVG), plus task prune."""
+
+import time
+
+import numpy as np
+import pytest
+
+from kubeml_tpu.api.types import TrainOptions, TrainRequest
+
+LM_FN = """
+import optax
+from kubeml_tpu.runtime.model import KubeModel
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.models.gpt import CausalTransformer
+
+class Tokens(KubeDataset):
+    def __init__(self):
+        super().__init__("tokens")
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(Tokens())
+    def build(self):
+        # self.mesh is set by the SPMD engine before build()
+        return CausalTransformer(vocab_size=64, max_len=16, embed_dim=32,
+                                 depth=2, num_heads=4, mesh=self.mesh)
+    def configure_optimizers(self):
+        return optax.adamw(self.lr)
+"""
+
+
+def token_data(n, l=16, vocab=64, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.integers(1, vocab, size=(n, l)).astype(np.int32)
+    x[:, -1] = 0
+    return x
+
+
+@pytest.fixture
+def token_store(tmp_config):
+    from kubeml_tpu.storage import ShardStore
+
+    store = ShardStore(config=tmp_config)
+    xtr = token_data(256, seed=1)
+    xte = token_data(64, seed=2)
+    # labels unused by the LM objective but the store requires them
+    store.create("tokens", xtr, np.zeros(len(xtr), np.int64),
+                 xte, np.zeros(len(xte), np.int64))
+    return store
+
+
+def _spmd_request(**kw):
+    opts = kw.pop("options", {})
+    opts.setdefault("engine", "spmd")
+    opts.setdefault("precision", "f32")
+    opts.setdefault("validate_every", 1)
+    return TrainRequest(
+        batch_size=kw.pop("batch_size", 16), epochs=kw.pop("epochs", 2),
+        dataset="tokens", lr=kw.pop("lr", 1e-3), function_name="lmfn",
+        options=TrainOptions(**opts),
+    )
+
+
+def test_spmd_job_direct(token_store, tmp_config):
+    """SPMDJob trains an LM over a dp x sp x tp mesh and records history."""
+    import importlib.util, sys
+
+    from kubeml_tpu.engine.spmd_job import SPMDJob
+    from kubeml_tpu.functions.registry import FunctionRegistry
+    from kubeml_tpu.storage import CheckpointStore, HistoryStore
+
+    reg = FunctionRegistry(config=tmp_config)
+    reg.create("lmfn", LM_FN)
+    model = reg.load("lmfn")
+    model._set_params(lr=1e-3, batch_size=16, epoch=0, k=1, task="train")
+    req = _spmd_request(options={"mesh_shape": {"dp": 2, "sp": 2, "tp": 2}})
+    job = SPMDJob("spmd1", req, model, store=token_store,
+                  history_store=HistoryStore(config=tmp_config),
+                  checkpoint_store=CheckpointStore(config=tmp_config))
+    assert dict(job.mesh.shape)["tp"] == 2 and dict(job.mesh.shape)["sp"] == 2
+    hist = job.train()
+    assert len(hist.train_loss) == 2
+    assert hist.train_loss[-1] < hist.train_loss[0]
+    assert len(hist.validation_loss) == 2
+    assert hist.parallelism == [8, 8]
+    # final model exported; greedy infer produces token ids
+    assert "final" in CheckpointStore(config=tmp_config).tags("spmd1")
+    preds = job.infer(token_data(2))
+    assert preds.shape == (2, 16) and preds.max() < 64
+
+
+def test_spmd_job_through_ps(token_store, tmp_config):
+    """The control plane dispatches engine='spmd' to the SPMD job class."""
+    from kubeml_tpu.api.types import TrainTask
+    from kubeml_tpu.functions.registry import FunctionRegistry
+    from kubeml_tpu.ps.parameter_server import ParameterServer
+
+    reg = FunctionRegistry(config=tmp_config)
+    reg.create("lmfn", LM_FN)
+    ps = ParameterServer(registry=reg, store=token_store, config=tmp_config)
+    req = _spmd_request(epochs=1, options={"mesh_shape": {"tp": 2}})
+    ps.start_task(TrainTask(job_id="spmd2", parameters=req))
+    assert ps.wait("spmd2", timeout=300)
+    from kubeml_tpu.storage import HistoryStore
+
+    hist = HistoryStore(config=tmp_config).get("spmd2")
+    assert len(hist.train_loss) == 1
+    assert np.isfinite(hist.train_loss[0])
+
+
+def test_spmd_job_resume(token_store, tmp_config):
+    """--resume restores the checkpointed params and continues the epoch count."""
+    from kubeml_tpu.engine.spmd_job import SPMDJob
+    from kubeml_tpu.functions.registry import FunctionRegistry
+    from kubeml_tpu.storage import CheckpointStore, HistoryStore
+
+    reg = FunctionRegistry(config=tmp_config)
+    reg.create("lmfn", LM_FN)
+
+    def make_job(epochs, resume):
+        model = reg.load("lmfn")
+        model._set_params(lr=1e-3, batch_size=16, epoch=0, k=1, task="train")
+        req = _spmd_request(epochs=epochs,
+                            options={"mesh_shape": {"tp": 2},
+                                     "checkpoint_every": 1, "resume": resume})
+        return SPMDJob("spmdres", req, model, store=token_store,
+                       history_store=HistoryStore(config=tmp_config),
+                       checkpoint_store=CheckpointStore(config=tmp_config))
+
+    h1 = make_job(2, resume=False).train()
+    assert len(h1.train_loss) == 2
+    h2 = make_job(4, resume=True).train()
+    assert len(h2.train_loss) == 4  # 2 restored + 2 new
+    # the restored run continues improving from the restored weights
+    assert h2.train_loss[-1] < h1.train_loss[-1]
+
+
+def test_spmd_engine_option_validation():
+    with pytest.raises(ValueError, match="engine"):
+        TrainOptions(engine="nosuch")
+
+
+def test_cli_mesh_flag_parses(tmp_config, capsys):
+    from kubeml_tpu.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["train", "-f", "x", "-d", "y", "--engine", "spmd", "--mesh", "tp=2,sp=4"]
+    )
+    assert args.engine == "spmd" and args.mesh == "tp=2,sp=4"
+
+
+def test_task_prune_cleans_dead_records(token_store, tmp_config):
+    """prune removes records whose thread died without finishing (simulated)."""
+    import threading
+
+    from kubeml_tpu.api.types import TrainTask
+    from kubeml_tpu.ps.parameter_server import ParameterServer, _JobRecord
+
+    ps = ParameterServer(store=token_store, config=tmp_config)
+    dead_thread = threading.Thread(target=lambda: None)
+    dead_thread.start()
+    dead_thread.join()
+    task = TrainTask(job_id="leaked", parameters=_spmd_request())
+    with ps._lock:
+        ps._jobs["leaked"] = _JobRecord(task=task, job=None, thread=dead_thread)
+    assert ps.prune_tasks() == 1
+    assert ps.list_tasks() == []
+    assert ps.prune_tasks() == 0
