@@ -104,6 +104,27 @@ class Model(KubeModel):
         return optax.adamw(self.lr)
 """
 
+_LM_FN = """
+import optax
+from kubeml_tpu.runtime.model import KubeModel
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.models.gpt import CausalTransformer
+
+class Ds(KubeDataset):
+    def __init__(self):
+        super().__init__({dataset!r})
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(Ds())
+    def build(self):
+        return CausalTransformer(vocab_size={vocab}, max_len={seq_len},
+                                 embed_dim={dim}, depth={depth}, num_heads=4,
+                                 mesh=self.mesh)
+    def configure_optimizers(self):
+        return optax.adamw(self.lr)
+"""
+
 
 @dataclass
 class Scenario:
@@ -142,10 +163,23 @@ def scenarios() -> List[Scenario]:
 
         return make
 
+    def lm_tokens(seq_len, vocab, n_train, n_quick):
+        def make(quick: bool):
+            r = np.random.default_rng(1)
+            n = n_quick if quick else n_train
+            x = r.integers(1, vocab, size=(n, seq_len)).astype(np.int64)
+            x[:, -2:] = 0
+            xte = r.integers(1, vocab, size=(max(64, n // 8), seq_len)).astype(np.int64)
+            xte[:, -2:] = 0
+            return (x, np.zeros(n, np.int64), xte, np.zeros(len(xte), np.int64))
+
+        return make
+
     lenet = _IMAGE_FN.format(module="lenet", model="LeNet", dataset="mnist-bench", classes=10)
     resnet = _IMAGE_FN.format(module="resnet", model="ResNet18", dataset="cifar10-bench", classes=10)
     vit = _IMAGE_FN.format(module="vit", model="ViTTiny", dataset="cifar100-bench", classes=100)
     bert = _TEXT_FN.format(dataset="sst2-bench", classes=2, vocab=1000, seq_len=64)
+    gptlm = _LM_FN.format(dataset="lm-bench", vocab=512, seq_len=32, dim=64, depth=2)
 
     return [
         # 1: LeNet/MNIST single function (BASELINE target #1)
@@ -187,6 +221,17 @@ def scenarios() -> List[Scenario]:
             quick_request=_req("bert-sst2", "sst2-bench", epochs=1, batch_size=16, lr=3e-4,
                                options=dict(default_parallelism=2, static_parallelism=True,
                                             k=2, precision="f32")),
+        ),
+        # 6 (TPU-native extension beyond BASELINE's five): GPT LM over the SPMD
+        # mesh engine through the same control-plane path
+        Scenario(
+            "gpt-lm-spmd", gptlm, lm_tokens(32, 512, 20000, 256),
+            request=_req("gpt-lm-spmd", "lm-bench", epochs=3, batch_size=64, lr=3e-4,
+                         options=dict(engine="spmd", precision="bf16",
+                                      mesh_shape={"tp": 2}, validate_every=1)),
+            quick_request=_req("gpt-lm-spmd", "lm-bench", epochs=1, batch_size=16, lr=3e-4,
+                               options=dict(engine="spmd", precision="f32",
+                                            mesh_shape={"tp": 2}, validate_every=1)),
         ),
     ]
 

@@ -1,2 +1,12 @@
 from .kavg import KAvgTrainer, worker_mesh  # noqa: F401
 from .job import TrainJob  # noqa: F401
+
+
+def job_class_for(options):
+    """The job class implementing ``options.engine`` — the single dispatch
+    point shared by the PS and the standalone runner."""
+    if options.engine == "spmd":
+        from .spmd_job import SPMDJob
+
+        return SPMDJob
+    return TrainJob

@@ -367,29 +367,17 @@ class TrainJob:
             log.exception("%s: checkpoint save failed (non-fatal)", self.job_id)
 
     def _restore_latest(self) -> int:
-        """Restore the newest checkpoint for this job id — an epoch checkpoint
-        (resume from epoch+1) or the final export (resume from its recorded
-        epoch count, so a default-options job with only ``final.npz`` resumes
-        too). Returns the epoch to resume from (0 = nothing to restore)."""
-        store = self.checkpoint_store
-        tags = store.tags(self.job_id)
-        if not tags:
+        """Restore the newest checkpoint (selection shared with the SPMD
+        engine, engine/resume.py). Returns the epoch to resume from (0 =
+        nothing to restore)."""
+        from .resume import extend_history, select_resume_checkpoint
+
+        best = select_resume_checkpoint(self.checkpoint_store, self.job_id)
+        if best is None:
             return 0
-        best = None  # (start_epoch, Checkpoint)
-        last = store.latest_epoch(self.job_id)
-        if last is not None:
-            best = (last + 1, store.restore(self.job_id, epoch=last))
-        if FINAL_TAG in tags:
-            # final.epoch records completed-epoch count == next epoch index; it
-            # can trail the newest epoch checkpoint after a mid-run crash
-            ck_final = store.restore(self.job_id, tag=FINAL_TAG)
-            if best is None or ck_final.epoch > best[0]:
-                best = (ck_final.epoch, ck_final)
         start_epoch, ck = best
         self._stacked_vars = self.trainer.place_reference(ck.variables, self.parallelism)
-        for key, vals in ck.meta.get("history", {}).items():
-            if hasattr(self.history, key):
-                getattr(self.history, key).extend(vals)
+        extend_history(self.history, ck)
         log.info("%s: resumed from checkpoint %s (epoch %d)", self.job_id, ck.tag, start_epoch)
         return start_epoch
 

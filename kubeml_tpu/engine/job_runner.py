@@ -91,8 +91,6 @@ class JobRunner:
         from ..storage.checkpoint import CheckpointStore
         from ..storage.history import HistoryStore
         from ..storage.store import ShardStore
-        from .job import TrainJob
-
         with self._lock:
             if self.job is not None:
                 raise KubeMLError(f"job {self.job_id} already started", 400)
@@ -104,12 +102,9 @@ class JobRunner:
             request.options.default_parallelism = (
                 task.state.parallelism or request.options.default_parallelism
             )
-            job_cls = TrainJob
-            if request.options.engine == "spmd":
-                from .spmd_job import SPMDJob
+            from . import job_class_for
 
-                job_cls = SPMDJob
-            self.job = job_cls(
+            self.job = job_class_for(request.options)(
                 self.job_id, request, model,
                 store=ShardStore(config=self.cfg),
                 history_store=HistoryStore(config=self.cfg),

@@ -1,0 +1,41 @@
+"""Checkpoint-resume selection shared by both training engines.
+
+One definition of "the newest checkpoint" so K-AVG (engine/job.py) and SPMD
+(engine/spmd_job.py) cannot drift: prefer whichever of (latest epoch
+checkpoint, final export) resumes furthest. The final export records its
+completed-epoch count as ``epoch`` — i.e. the next epoch index — while an
+epoch checkpoint ``epNNNNN`` resumes at ``N+1``; after a mid-run crash the
+newest epoch checkpoint can be AHEAD of an older run's final export, so the
+max of the two start epochs wins.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..storage.checkpoint import FINAL_TAG, Checkpoint, CheckpointStore
+
+
+def select_resume_checkpoint(
+    store: CheckpointStore, job_id: str
+) -> Optional[Tuple[int, Checkpoint]]:
+    """(start_epoch, checkpoint) to resume from, or None when nothing exists."""
+    tags = store.tags(job_id)
+    if not tags:
+        return None
+    best: Optional[Tuple[int, Checkpoint]] = None
+    last = store.latest_epoch(job_id)
+    if last is not None:
+        best = (last + 1, store.restore(job_id, epoch=last))
+    if FINAL_TAG in tags:
+        ck_final = store.restore(job_id, tag=FINAL_TAG)
+        if best is None or ck_final.epoch > best[0]:
+            best = (ck_final.epoch, ck_final)
+    return best
+
+
+def extend_history(history, ck: Checkpoint) -> None:
+    """Splice the checkpoint's recorded history lists back onto a fresh History."""
+    for key, vals in ck.meta.get("history", {}).items():
+        if hasattr(history, key):
+            getattr(history, key).extend(vals)

@@ -86,6 +86,7 @@ class SPMDJob:
         self.history = History(id=job_id, task={"request": request.to_dict()})
         self.stop_event = threading.Event()
         self.exit_error: Optional[str] = None
+        self._dataset_handle = None
         # live inference and a donating train step must not touch the same
         # buffers concurrently (donation invalidates the inputs)
         self._step_lock = threading.Lock()
@@ -107,12 +108,17 @@ class SPMDJob:
 
     # --- data ---
 
+    @property
+    def _handle(self):
+        if self._dataset_handle is None:
+            self._dataset_handle = self.store.get(self.request.dataset)
+        return self._dataset_handle
+
     def _token_batches(self, split: str, batch: int):
         """Global [batch, L] token slabs; remainder rows beyond a dp-divisible
         batch are dropped (SPMD batches must tile the dp axis)."""
-        handle = self.store.get(self.request.dataset)
-        n = handle.num_samples(split)
-        x = handle._load(split, "data")
+        n = self._handle.num_samples(split)
+        x = self._handle.raw(split, "data")
         dp = int(self.mesh.shape.get("dp", 1))
         batch = max(dp, (batch // dp) * dp)
         for a in range(0, n - batch + 1, batch):
@@ -194,31 +200,22 @@ class SPMDJob:
     # --- internals ---
 
     def _restore_latest(self) -> int:
-        """Restore the newest checkpoint (epoch or final) into the sharded
-        params, continuing at the recorded epoch. Optimizer state restarts —
-        consistent with the K-AVG engine's per-sync optimizer reset."""
+        """Restore the newest checkpoint into the sharded params (selection
+        shared with the K-AVG engine, engine/resume.py). Optimizer state
+        restarts — consistent with K-AVG's per-sync optimizer reset."""
         import flax.core.meta as meta
 
-        store = self.checkpoint_store
-        tags = store.tags(self.job_id)
-        if not tags:
+        from .resume import extend_history, select_resume_checkpoint
+
+        best = select_resume_checkpoint(self.checkpoint_store, self.job_id)
+        if best is None:
             return 0
-        best = None  # (start_epoch, Checkpoint)
-        last = store.latest_epoch(self.job_id)
-        if last is not None:
-            best = (last + 1, store.restore(self.job_id, epoch=last))
-        if FINAL_TAG in tags:
-            ck_final = store.restore(self.job_id, tag=FINAL_TAG)
-            if best is None or ck_final.epoch > best[0]:
-                best = (ck_final.epoch, ck_final)
         start_epoch, ck = best
         unboxed = meta.unbox(self.trainer.params)
         shardings = jax.tree.map(lambda x: x.sharding, unboxed)
         placed = jax.device_put(ck.variables, shardings)
         self.trainer.params = meta.replace_boxed(self.trainer.params, placed)
-        for key, vals in ck.meta.get("history", {}).items():
-            if hasattr(self.history, key):
-                getattr(self.history, key).extend(vals)
+        extend_history(self.history, ck)
         log.info("%s: resumed from checkpoint %s (epoch %d)", self.job_id,
                  ck.tag, start_epoch)
         return start_epoch
@@ -226,9 +223,8 @@ class SPMDJob:
     def _validate(self) -> Optional[float]:
         vals = []
         with self.tracer.span("job.validate", job=self.job_id, engine="spmd"):
-            with jax.set_mesh(self.mesh):
-                for batch in self._token_batches("test", self.request.batch_size):
-                    vals.append(self.trainer.eval_loss(batch))
+            for batch in self._token_batches("test", self.request.batch_size):
+                vals.append(self.trainer.eval_loss(batch))  # enters the mesh itself
         return float(np.mean(vals)) if vals else None
 
     def _host_params(self):

@@ -117,12 +117,9 @@ class ParameterServer:
             req.options.default_parallelism = (
                 task.state.parallelism or req.options.default_parallelism
             )
-            job_cls = TrainJob
-            if req.options.engine == "spmd":
-                from ..engine.spmd_job import SPMDJob
+            from ..engine import job_class_for
 
-                job_cls = SPMDJob
-            job = job_cls(
+            job = job_class_for(req.options)(
                 task.job_id,
                 req,
                 model,
@@ -337,6 +334,19 @@ class ParameterServer:
                     and not record.thread.is_alive()):
                 record.task.status = JobStateEnum.FAILED
                 if self._finish(job_id, expect=record):
+                    # completion pollers key off the history record existing;
+                    # a thread that died before saving one gets it here (same
+                    # contract as _handle_runner_death)
+                    try:
+                        self.history_store.get(job_id)
+                    except Exception:
+                        from ..api.types import History
+
+                        self.history_store.save(History(
+                            id=job_id,
+                            task={"request": record.task.parameters.to_dict(),
+                                  "error": "job thread died without finishing"},
+                        ))
                     pruned += 1
         return pruned
 

@@ -55,6 +55,11 @@ class DatasetHandle:
             self._arrays[key] = np.load(f, mmap_mode="r")
         return self._arrays[key]
 
+    def raw(self, split: str, kind: str = "data") -> np.ndarray:
+        """The whole split as a memory-mapped array (zero-copy; callers slice).
+        ``kind`` is "data" or "labels"."""
+        return self._load(split, kind)
+
     def num_samples(self, split: str) -> int:
         return int(self.manifest["splits"][split]["samples"])
 

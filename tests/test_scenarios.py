@@ -25,13 +25,14 @@ def test_synthetic_generators():
 
 def test_scenario_definitions_cover_baseline():
     names = [s.name for s in scenarios()]
-    assert names == ["lenet-mnist", "resnet18-cifar10", "vit-cifar100", "bert-sst2"]
+    assert names == ["lenet-mnist", "resnet18-cifar10", "vit-cifar100",
+                     "bert-sst2", "gpt-lm-spmd"]
     for s in scenarios():
         assert s.function_source.strip()
         assert s.request.dataset and s.request.function_name
 
 
-@pytest.mark.parametrize("name", ["lenet-mnist", "bert-sst2"])
+@pytest.mark.parametrize("name", ["lenet-mnist", "bert-sst2", "gpt-lm-spmd"])
 def test_single_scenario_quick(tmp_config, name):
     sc = {s.name: s for s in scenarios()}[name]
     with ExperimentDriver(tmp_config) as driver:
