@@ -223,17 +223,24 @@ def scenarios() -> List[Scenario]:
                                             k=2, precision="f32")),
         ),
         # 6 (TPU-native extension beyond BASELINE's five): GPT LM over the SPMD
-        # mesh engine through the same control-plane path
+        # mesh engine through the same control-plane path. tp spans 2 devices
+        # when the host has them; on a single chip the mesh is all-dp(1).
         Scenario(
             "gpt-lm-spmd", gptlm, lm_tokens(32, 512, 20000, 256),
             request=_req("gpt-lm-spmd", "lm-bench", epochs=3, batch_size=64, lr=3e-4,
                          options=dict(engine="spmd", precision="bf16",
-                                      mesh_shape={"tp": 2}, validate_every=1)),
+                                      mesh_shape=_spmd_mesh(), validate_every=1)),
             quick_request=_req("gpt-lm-spmd", "lm-bench", epochs=1, batch_size=16, lr=3e-4,
                                options=dict(engine="spmd", precision="f32",
-                                            mesh_shape={"tp": 2}, validate_every=1)),
+                                            mesh_shape=_spmd_mesh(), validate_every=1)),
         ),
     ]
+
+
+def _spmd_mesh() -> Dict[str, int]:
+    import jax
+
+    return {"tp": 2} if len(jax.devices()) >= 2 else {}
 
 
 @dataclass

@@ -117,6 +117,8 @@ def cmd_function(args) -> int:
     elif args.action == "delete":
         c.delete(args.name)
         print(f"deleted {args.name}")
+    elif args.action == "get":
+        _print(c.get(args.name))
     else:
         _print(c.list())
     return 0
@@ -302,6 +304,8 @@ def build_parser() -> argparse.ArgumentParser:
     fc.add_argument("--code", required=True, help="path to the .py source file")
     fd = fsub.add_parser("delete")
     fd.add_argument("--name", "-n", required=True)
+    fg = fsub.add_parser("get")
+    fg.add_argument("--name", "-n", required=True)
     fsub.add_parser("list")
     f.set_defaults(fn=cmd_function)
 

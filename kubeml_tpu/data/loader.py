@@ -55,8 +55,8 @@ def _worker_round_slice(
     b = min(a + per_round, hi)
     if a >= b:
         return None, None  # this worker is already exhausted (padded-only round)
-    x = handle._load(split, "data")[a:b]
-    y = handle._load(split, "labels")[a:b]
+    x = handle.raw(split, "data")[a:b]
+    y = handle.raw(split, "labels")[a:b]
     return x, y
 
 

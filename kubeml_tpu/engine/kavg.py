@@ -336,7 +336,8 @@ class KAvgTrainer:
 
     def _eval_sums(self, variables, batch_x, batch_y, mask):
         n = batch_x.shape[0]
-        key = (n, batch_x.shape[1:], batch_y.shape[1:])
+        key = (n, batch_x.shape[1:], str(batch_x.dtype),
+               batch_y.shape[1:], str(batch_y.dtype))
         fn = self._eval_cache.get(key)
         if fn is None:
             fn = self._build_eval(n)

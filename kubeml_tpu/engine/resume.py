@@ -23,15 +23,16 @@ def select_resume_checkpoint(
     tags = store.tags(job_id)
     if not tags:
         return None
-    best: Optional[Tuple[int, Checkpoint]] = None
+    # decide the winner from metadata alone; only the winning checkpoint's
+    # weight arrays are ever read off disk
     last = store.latest_epoch(job_id)
-    if last is not None:
-        best = (last + 1, store.restore(job_id, epoch=last))
     if FINAL_TAG in tags:
-        ck_final = store.restore(job_id, tag=FINAL_TAG)
-        if best is None or ck_final.epoch > best[0]:
-            best = (ck_final.epoch, ck_final)
-    return best
+        final_epoch = int(store.read_meta(job_id, FINAL_TAG).get("epoch", 0))
+        if last is None or final_epoch > last + 1:
+            return (final_epoch, store.restore(job_id, tag=FINAL_TAG))
+    if last is None:
+        return None
+    return (last + 1, store.restore(job_id, epoch=last))
 
 
 def extend_history(history, ck: Checkpoint) -> None:

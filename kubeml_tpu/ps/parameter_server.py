@@ -153,18 +153,25 @@ class ParameterServer:
             self._serving_cache.pop(task.job_id, None)
         return placeholder
 
+    def _ensure_failure_history(self, job_id: str, request, error: str) -> None:
+        """Guarantee a History record exists for a dead job (completion pollers
+        key off it); keeps any record the job itself managed to save."""
+        try:
+            self.history_store.get(job_id)
+        except Exception:
+            from ..api.types import History
+
+            self.history_store.save(History(
+                id=job_id, task={"request": request.to_dict(), "error": error}
+            ))
+
     def _fail_start(self, task: TrainTask, error: Exception) -> None:
         """Failed-start bookkeeping: FAILED status, slot freed, error history
         persisted so pollers see the outcome."""
-        from ..api.types import History
-
         task.status = JobStateEnum.FAILED
         with self._lock:
             self._jobs.pop(task.job_id, None)
-        self.history_store.save(
-            History(id=task.job_id,
-                    task={"request": task.parameters.to_dict(), "error": str(error)})
-        )
+        self._ensure_failure_history(task.job_id, task.parameters, str(error))
 
     # --- standalone mode (reference: ps/job_pod.go + train/client) ---
 
@@ -245,16 +252,10 @@ class ParameterServer:
         log.error("standalone job %s runner exited (code %s) without reporting; "
                   "marking failed", job_id, record.proc.returncode)
         record.task.status = JobStateEnum.FAILED
-        try:
-            self.history_store.get(job_id)  # runner may have saved one
-        except Exception:
-            from ..api.types import History
-
-            self.history_store.save(History(
-                id=job_id,
-                task={"request": record.task.parameters.to_dict(),
-                      "error": f"job runner exited with code {record.proc.returncode}"},
-            ))
+        self._ensure_failure_history(
+            job_id, record.task.parameters,
+            f"job runner exited with code {record.proc.returncode}",
+        )
         return self._finish(job_id, expect=record)
 
     def _ensure_monitor(self) -> None:
@@ -333,20 +334,13 @@ class ParameterServer:
                     and record.thread.ident is not None
                     and not record.thread.is_alive()):
                 record.task.status = JobStateEnum.FAILED
+                # history BEFORE the record drops: a poller must never observe
+                # neither task nor history (same order as _handle_runner_death)
+                self._ensure_failure_history(
+                    job_id, record.task.parameters,
+                    "job thread died without finishing",
+                )
                 if self._finish(job_id, expect=record):
-                    # completion pollers key off the history record existing;
-                    # a thread that died before saving one gets it here (same
-                    # contract as _handle_runner_death)
-                    try:
-                        self.history_store.get(job_id)
-                    except Exception:
-                        from ..api.types import History
-
-                        self.history_store.save(History(
-                            id=job_id,
-                            task={"request": record.task.parameters.to_dict(),
-                                  "error": "job thread died without finishing"},
-                        ))
                     pruned += 1
         return pruned
 

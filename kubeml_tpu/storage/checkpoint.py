@@ -215,6 +215,15 @@ class CheckpointStore:
         path = self.export_path(job_id, epoch=epoch, tag=tag)
         return _read_file(path, job_id, path.stem)
 
+    def read_meta(self, job_id: str, tag: str) -> Dict[str, Any]:
+        """The checkpoint's metadata record WITHOUT loading any weight arrays
+        (npz members are lazy; only ``__meta__`` is read)."""
+        path = self._tag_path(job_id, tag)
+        if not path.exists():
+            raise CheckpointNotFoundError(f"{job_id}/{tag}")
+        with np.load(path) as z:
+            return json.loads(bytes(z[META_KEY]).decode())
+
     def list_jobs(self) -> List[str]:
         return sorted(
             p.name
