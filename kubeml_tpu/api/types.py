@@ -103,6 +103,7 @@ class TrainOptions(_JsonMixin):
     donate: bool = True  # donate params buffers into the jitted step
     # --- checkpoint/resume (closes reference gap SURVEY §5: weights died with job) ---
     checkpoint_every: int = 0  # save a checkpoint every N epochs; 0 = off
+    checkpoint_keep: int = 0  # retain only the newest N epoch checkpoints; 0 = all
     resume: bool = False  # restore the latest checkpoint for this job id and continue
     save_model: bool = True  # export the final model at job end (enables later infer)
     # --- fault injection (chaos testing; the reference only mentions chaos-monkey) ---
@@ -115,6 +116,8 @@ class TrainOptions(_JsonMixin):
             raise ValueError("validate_every must be >= 0")
         if self.checkpoint_every < 0:
             raise ValueError("checkpoint_every must be >= 0")
+        if self.checkpoint_keep < 0:
+            raise ValueError("checkpoint_keep must be >= 0")
         if not (0.0 <= self.chaos_prob <= 1.0):
             raise ValueError("chaos_prob must be in [0, 1]")
         if self.k == 0 or self.k < -1:

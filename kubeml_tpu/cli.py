@@ -234,15 +234,22 @@ def cmd_start(args) -> int:
             logging.FileHandler(log_dir / "kubeml.log"),
         ],
     )
+    import signal
+    import threading
+
     from .cluster import LocalCluster
 
+    stop = threading.Event()
+    # systemd stops services with SIGTERM: shut the cluster down cleanly
+    # (terminate standalone runners, close sockets) instead of dying mid-job
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
     with LocalCluster(config=cfg) as cluster:
         print(f"kubeml-tpu cluster running; controller at {cluster.controller_url}")
         try:
-            while True:
-                time.sleep(3600)
+            stop.wait()
         except KeyboardInterrupt:
-            print("shutting down")
+            pass
+        print("shutting down")
     return 0
 
 

@@ -141,15 +141,21 @@ class JobRunner:
         box[0].set()
         return {}
 
+    def request_stop(self) -> None:
+        """Cooperative stop: flag the job AND unblock a pending epoch-end wait
+        (used by the /stop route and the SIGTERM handler alike)."""
+        if self.job is not None:
+            self.job.stop()
+        with self._lock:
+            if self._update_box is not None:
+                self._update_box[0].set()
+
     def _stop(self, req):
         from ..api.errors import JobNotFoundError
 
         if self.job is None:
             raise JobNotFoundError(self.job_id)
-        self.job.stop()
-        with self._lock:
-            if self._update_box is not None:  # unblock a pending epoch-end wait
-                self._update_box[0].set()
+        self.request_stop()
         return {}
 
     def _infer(self, req):
@@ -252,8 +258,20 @@ def main(argv=None) -> int:
     runner = JobRunner(args.job_id, port=args.port).start()
     # the parent reads this line to learn the bound port (job_pod readiness)
     print(f"LISTENING {runner.service.port}", flush=True)
+    import signal
     import time
 
+    # the PS terminates runners with SIGTERM on cluster shutdown: request a
+    # cooperative job stop — the job thread finishes its round, flushes
+    # history/checkpoints in its finally, and sets `done` itself; only a
+    # runner that never received /start exits immediately
+    def _on_term(*_):
+        if runner.job is not None:
+            runner.request_stop()  # also unblocks a pending epoch-end wait
+        else:
+            runner.done.set()
+
+    signal.signal(signal.SIGTERM, _on_term)
     try:
         # serve until the job completes (plus a linger for late /state reads);
         # a runner that never receives /start waits for the parent to kill it

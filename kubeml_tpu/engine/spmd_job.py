@@ -250,6 +250,9 @@ class SPMDJob:
                     meta={"request": self.request.to_dict(),
                           "history": self._history_lists()},
                 )
+                self.checkpoint_store.prune_epochs(
+                    self.job_id, self.request.options.checkpoint_keep
+                )
         except Exception:
             log.exception("%s: checkpoint save failed (non-fatal)", self.job_id)
 

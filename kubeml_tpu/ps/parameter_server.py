@@ -167,11 +167,18 @@ class ParameterServer:
 
     def _fail_start(self, task: TrainTask, error: Exception) -> None:
         """Failed-start bookkeeping: FAILED status, slot freed, error history
-        persisted so pollers see the outcome."""
+        persisted so pollers see the outcome. Saves UNCONDITIONALLY — a reused
+        job id may carry a stale success history from its previous run, and
+        this submission's failure must not hide behind it."""
+        from ..api.types import History
+
         task.status = JobStateEnum.FAILED
         with self._lock:
             self._jobs.pop(task.job_id, None)
-        self._ensure_failure_history(task.job_id, task.parameters, str(error))
+        self.history_store.save(History(
+            id=task.job_id,
+            task={"request": task.parameters.to_dict(), "error": str(error)},
+        ))
 
     # --- standalone mode (reference: ps/job_pod.go + train/client) ---
 
@@ -241,22 +248,28 @@ class ParameterServer:
         self._ensure_monitor()
         log.info("standalone job %s running at %s (pid %d)", task.job_id, url, proc.pid)
 
-    def _handle_runner_death(self, job_id: str, record: _JobRecord) -> bool:
-        """Cleanup after a runner died without its /finish callback (crash,
-        OOM-kill): fail the task, persist a history record (completion pollers
-        key off it), and tear down — guarded against stale records. Returns
-        whether this call actually performed the teardown."""
+    def _fail_dead_record(self, job_id: str, record: _JobRecord, error: str) -> bool:
+        """Shared teardown for a job whose runner/thread died without finishing:
+        stale-record guard FIRST (a resubmitted live job must never get a
+        spurious failure history), then history, then the guarded finish."""
         with self._lock:
             if self._jobs.get(job_id) is not record:
                 return False  # already finished, or the id belongs to a new job
-        log.error("standalone job %s runner exited (code %s) without reporting; "
-                  "marking failed", job_id, record.proc.returncode)
         record.task.status = JobStateEnum.FAILED
-        self._ensure_failure_history(
-            job_id, record.task.parameters,
+        self._ensure_failure_history(job_id, record.task.parameters, error)
+        return self._finish(job_id, expect=record)
+
+    def _handle_runner_death(self, job_id: str, record: _JobRecord) -> bool:
+        """Cleanup after a runner died without its /finish callback (crash,
+        OOM-kill). Returns whether this call performed the teardown."""
+        handled = self._fail_dead_record(
+            job_id, record,
             f"job runner exited with code {record.proc.returncode}",
         )
-        return self._finish(job_id, expect=record)
+        if handled:
+            log.error("standalone job %s runner exited (code %s) without "
+                      "reporting; marked failed", job_id, record.proc.returncode)
+        return handled
 
     def _ensure_monitor(self) -> None:
         """A liveness monitor for standalone runners (the reference's pod
@@ -333,14 +346,8 @@ class ParameterServer:
             if (record.proc is None and record.thread is not None
                     and record.thread.ident is not None
                     and not record.thread.is_alive()):
-                record.task.status = JobStateEnum.FAILED
-                # history BEFORE the record drops: a poller must never observe
-                # neither task nor history (same order as _handle_runner_death)
-                self._ensure_failure_history(
-                    job_id, record.task.parameters,
-                    "job thread died without finishing",
-                )
-                if self._finish(job_id, expect=record):
+                if self._fail_dead_record(job_id, record,
+                                          "job thread died without finishing"):
                     pruned += 1
         return pruned
 

@@ -215,6 +215,21 @@ class CheckpointStore:
         path = self.export_path(job_id, epoch=epoch, tag=tag)
         return _read_file(path, job_id, path.stem)
 
+    def prune_epochs(self, job_id: str, keep: int) -> int:
+        """Retain only the newest ``keep`` epoch checkpoints (the final export
+        is never touched). Returns how many were deleted; keep <= 0 is a no-op."""
+        if keep <= 0:
+            return 0
+        eps = self.epochs(job_id)
+        n = 0
+        for epoch in eps[:-keep] if len(eps) > keep else []:
+            try:
+                self.delete(job_id, tag=_tag_for_epoch(epoch))
+                n += 1
+            except CheckpointNotFoundError:
+                pass  # concurrent delete; retention is best-effort
+        return n
+
     def read_meta(self, job_id: str, tag: str) -> Dict[str, Any]:
         """The checkpoint's metadata record WITHOUT loading any weight arrays
         (npz members are lazy; only ``__meta__`` is read)."""

@@ -203,6 +203,30 @@ def test_infer_from_finished_job_checkpoint(mnist_store, tmp_config):
     assert all(0 <= p < 10 for p in preds)
 
 
+def test_prune_epochs_retention(tmp_config):
+    store = CheckpointStore(config=tmp_config)
+    for e in range(5):
+        store.save("j", tree(e), epoch=e)
+    store.save("j", tree(9), epoch=5, tag=FINAL_TAG)
+    assert store.prune_epochs("j", keep=2) == 3
+    assert store.epochs("j") == [3, 4]
+    assert FINAL_TAG in store.tags("j")  # final never pruned
+    assert store.prune_epochs("j", keep=0) == 0  # 0 = keep all
+
+
+def test_job_checkpoint_keep(mnist_store, tmp_config):
+    """checkpoint_keep retains only the newest N epoch checkpoints."""
+    req = _request(
+        epochs=4,
+        options={"default_parallelism": 1, "static_parallelism": True, "k": 4,
+                 "checkpoint_every": 1, "checkpoint_keep": 2},
+    )
+    _job("ckkeep", req, mnist_store, tmp_config).train()
+    store = CheckpointStore(config=tmp_config)
+    assert store.epochs("ckkeep") == [2, 3]
+    assert FINAL_TAG in store.tags("ckkeep")
+
+
 def test_resume_from_final_only(mnist_store, tmp_config):
     """A job trained with default options (only final.npz) still resumes."""
     opts = {"default_parallelism": 1, "static_parallelism": True, "k": 4}
