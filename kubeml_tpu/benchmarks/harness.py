@@ -26,14 +26,18 @@ class Flagship:
     baseline_sps: float
 
 
-def flagship() -> Flagship:
+def flagship(dtype=None) -> Flagship:
     """The headline benchmark model: ResNet-18/CIFAR-10 when the resnet family
-    is available (BASELINE.md target #2), else LeNet/MNIST (target #1)."""
+    is available (BASELINE.md target #2), else LeNet/MNIST (target #1).
+
+    ``dtype`` selects the computation precision (e.g. ``jnp.bfloat16`` for the
+    MXU's native mixed-precision passes); None = model default (f32)."""
+    kw = {} if dtype is None else {"dtype": dtype}
     try:
         from ..models.resnet import ResNet18
 
         return Flagship(
-            module=ResNet18(num_classes=10),
+            module=ResNet18(num_classes=10, **kw),
             sample_shape=(32, 32, 3),
             name="resnet18-cifar10",
             num_classes=10,
@@ -43,7 +47,7 @@ def flagship() -> Flagship:
         from ..models.lenet import LeNet
 
         return Flagship(
-            module=LeNet(num_classes=10),
+            module=LeNet(num_classes=10, **kw),
             sample_shape=(28, 28, 1),
             name="lenet-mnist",
             num_classes=10,

@@ -5,9 +5,15 @@ No counterpart in the reference (CNNs only). Input is a ``[B, L]`` int32 token
 id array; id 0 is the padding token and drives the attention mask, so the model
 fits the platform's single-input contract (KubeModel.forward gets one x).
 Built on the shared attention op for the same swap-in reasons as ViT.
+
+``dtype`` is the computation dtype (bf16 compute / f32 params mixed precision):
+matmuls run in ``dtype``, LayerNorm and the attention softmax stay f32, and
+parameters (incl. embeddings) are always stored f32.
 """
 
 from __future__ import annotations
+
+from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -19,34 +25,36 @@ PAD_ID = 0
 
 class BertSelfAttention(nn.Module):
     num_heads: int
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, valid):
         B, L, E = x.shape
         H = self.num_heads
         D = E // H
-        q = nn.DenseGeneral((H, D), axis=-1, name="query")(x)
-        k = nn.DenseGeneral((H, D), axis=-1, name="key")(x)
-        v = nn.DenseGeneral((H, D), axis=-1, name="value")(x)
+        q = nn.DenseGeneral((H, D), axis=-1, name="query", dtype=self.dtype)(x)
+        k = nn.DenseGeneral((H, D), axis=-1, name="key", dtype=self.dtype)(x)
+        v = nn.DenseGeneral((H, D), axis=-1, name="value", dtype=self.dtype)(x)
         out = dot_product_attention(q, k, v, kv_valid=valid)
-        return nn.DenseGeneral(E, axis=(-2, -1), name="output")(out)
+        return nn.DenseGeneral(E, axis=(-2, -1), name="output", dtype=self.dtype)(out)
 
 
 class BertLayer(nn.Module):
     num_heads: int
     mlp_dim: int
     dropout: float = 0.1
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, valid, train: bool = False):
-        y = BertSelfAttention(self.num_heads)(x, valid)
+        y = BertSelfAttention(self.num_heads, dtype=self.dtype)(x, valid)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
-        x = nn.LayerNorm()(x + y)
-        y = nn.Dense(self.mlp_dim)(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x + y).astype(self.dtype)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
         y = nn.gelu(y)
-        y = nn.Dense(x.shape[-1])(y)
+        y = nn.Dense(x.shape[-1], dtype=self.dtype)(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
-        return nn.LayerNorm()(x + y)
+        return nn.LayerNorm(dtype=jnp.float32)(x + y).astype(self.dtype)
 
 
 class BertClassifier(nn.Module):
@@ -58,6 +66,7 @@ class BertClassifier(nn.Module):
     num_heads: int = 12
     mlp_dim: int = 3072
     dropout: float = 0.1
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, token_ids, train: bool = False):
@@ -66,23 +75,27 @@ class BertClassifier(nn.Module):
         valid = token_ids != PAD_ID  # [B, L] — drives kv masking in attention
         x = nn.Embed(self.vocab_size, self.embed_dim, name="token_embed")(token_ids)
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
-                         (1, self.max_len, self.embed_dim), x.dtype)
+                         (1, self.max_len, self.embed_dim), jnp.float32)
         x = x + pos[:, :L]
-        x = nn.LayerNorm()(x)
-        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x).astype(self.dtype)
         for _ in range(self.depth):
-            x = BertLayer(self.num_heads, self.mlp_dim, self.dropout)(x, valid, train=train)
+            x = BertLayer(self.num_heads, self.mlp_dim, self.dropout,
+                          dtype=self.dtype)(x, valid, train=train)
         # BERT pooler: tanh-projected [CLS]
-        pooled = nn.tanh(nn.Dense(self.embed_dim, name="pooler")(x[:, 0]))
+        pooled = nn.tanh(nn.Dense(self.embed_dim, name="pooler",
+                                  dtype=self.dtype)(x[:, 0]))
         pooled = nn.Dropout(self.dropout, deterministic=not train)(pooled)
-        return nn.Dense(self.num_classes)(pooled)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(pooled).astype(jnp.float32)
 
 
-def BertBase(num_classes: int = 2, vocab_size: int = 30522) -> BertClassifier:
-    return BertClassifier(num_classes=num_classes, vocab_size=vocab_size)
+def BertBase(num_classes: int = 2, vocab_size: int = 30522,
+             dtype: Any = jnp.float32) -> BertClassifier:
+    return BertClassifier(num_classes=num_classes, vocab_size=vocab_size, dtype=dtype)
 
 
-def BertTiny(num_classes: int = 2, vocab_size: int = 1000, max_len: int = 128) -> BertClassifier:
+def BertTiny(num_classes: int = 2, vocab_size: int = 1000, max_len: int = 128,
+             dtype: Any = jnp.float32) -> BertClassifier:
     """Test/CI-sized config (2 layers, 128 wide)."""
     return BertClassifier(num_classes=num_classes, vocab_size=vocab_size, max_len=max_len,
-                          embed_dim=128, depth=2, num_heads=2, mlp_dim=256)
+                          embed_dim=128, depth=2, num_heads=2, mlp_dim=256, dtype=dtype)

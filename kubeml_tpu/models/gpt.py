@@ -7,11 +7,15 @@ Every weight is annotated with ``nn.with_partitioning`` mesh-axis names so
 row-sharded; XLA inserts the psum on the row-sharded matmuls). Attention runs
 as ring attention over the ``sp`` axis when a mesh with sp > 1 is attached
 (jax.shard_map inside jit), else as plain full attention.
+
+``dtype`` is the computation dtype (bf16 compute / f32 params mixed precision):
+matmuls run in ``dtype``, LayerNorm and attention softmax stay f32, parameters
+are always stored f32, and logits are returned f32 for the loss.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -31,6 +35,7 @@ def _part(names):
 class CausalSelfAttention(nn.Module):
     num_heads: int
     mesh: Optional[Mesh] = None
+    dtype: Any = jnp.float32
     # sequence-parallel scheme when mesh.sp > 1: "ring" (ppermute K/V rotation,
     # kubeml_tpu.parallel.ring) or "ulysses" (head<->sequence all_to_all,
     # kubeml_tpu.parallel.ulysses — needs the per-tp-shard head count,
@@ -51,7 +56,7 @@ class CausalSelfAttention(nn.Module):
         dense = lambda feats, names, name: nn.Dense(
             feats, name=name,
             kernel_init=_part(names)(nn.initializers.lecun_normal()),
-            use_bias=False,
+            use_bias=False, dtype=self.dtype,
         )
         heads = lambda t: t.reshape(B, L, H, D)
         q = heads(dense(H * D, (None, "tp"), "query")(x))
@@ -94,21 +99,23 @@ class GPTBlock(nn.Module):
     dropout: float = 0.0
     mesh: Optional[Mesh] = None
     sp_impl: str = "ring"
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, valid, train: bool = False):
-        y = nn.LayerNorm(name="ln1")(x)
+        y = nn.LayerNorm(name="ln1", dtype=jnp.float32)(x).astype(self.dtype)
         y = CausalSelfAttention(self.num_heads, mesh=self.mesh,
-                                sp_impl=self.sp_impl, name="attn")(y, valid)
+                                sp_impl=self.sp_impl, dtype=self.dtype,
+                                name="attn")(y, valid)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
-        y = nn.LayerNorm(name="ln2")(x)
+        y = nn.LayerNorm(name="ln2", dtype=jnp.float32)(x).astype(self.dtype)
         E = x.shape[-1]
-        y = nn.Dense(E * self.mlp_ratio, name="mlp_in",
+        y = nn.Dense(E * self.mlp_ratio, name="mlp_in", dtype=self.dtype,
                      kernel_init=_part((None, "tp"))(nn.initializers.lecun_normal()),
                      bias_init=_part(("tp",))(nn.initializers.zeros))(y)
         y = nn.gelu(y)
-        y = nn.Dense(E, name="mlp_out",
+        y = nn.Dense(E, name="mlp_out", dtype=self.dtype,
                      kernel_init=_part(("tp", None))(nn.initializers.lecun_normal()))(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         return x + y
@@ -130,6 +137,7 @@ class CausalTransformer(nn.Module):
     dropout: float = 0.0
     mesh: Optional[Mesh] = None
     sp_impl: str = "ring"  # sequence-parallel scheme: "ring" | "ulysses"
+    dtype: Any = jnp.float32  # computation dtype; params stay f32
     # rematerialize dense blocks in backward (jax.checkpoint): trades ~1/3 more
     # FLOPs for O(depth) -> O(1) activation memory — the standard long-context
     # HBM lever. MoE blocks are left unrematerialized (their sown aux-loss
@@ -150,14 +158,14 @@ class CausalTransformer(nn.Module):
         pos = self.param("pos_embed",
                          _part((None, None, "tp"))(nn.initializers.normal(0.02)),
                          (1, self.max_len, self.embed_dim))
-        x = x + pos[:, :L]
+        x = (x + pos[:, :L]).astype(self.dtype)
         for i in range(self.depth):
             if self.moe_every > 0 and (i + 1) % self.moe_every == 0:
                 from ..parallel.moe import MoEBlock
 
                 x = MoEBlock(self.num_heads, self.num_experts, self.mlp_ratio,
                              self.top_k, self.dropout, mesh=self.mesh,
-                             sp_impl=self.sp_impl,
+                             sp_impl=self.sp_impl, dtype=self.dtype,
                              name=f"block_{i}")(x, valid, train=train)
             else:
                 # static_argnums counts self as 0, so `train` (a trace-time
@@ -167,20 +175,23 @@ class CausalTransformer(nn.Module):
                 )
                 x = block_cls(self.num_heads, self.mlp_ratio, self.dropout,
                               mesh=self.mesh, sp_impl=self.sp_impl,
-                              name=f"block_{i}")(x, valid, train)
-        x = nn.LayerNorm(name="ln_f")(x)
+                              dtype=self.dtype, name=f"block_{i}")(x, valid, train)
+        x = nn.LayerNorm(name="ln_f", dtype=jnp.float32)(x).astype(self.dtype)
         logits = nn.Dense(self.vocab_size, name="lm_head", use_bias=False,
+                          dtype=self.dtype,
                           kernel_init=_part((None, "tp"))(nn.initializers.lecun_normal()))(x)
-        return logits
+        return logits.astype(jnp.float32)
 
 
-def GPTTiny(vocab_size: int = 1000, max_len: int = 128, mesh=None) -> CausalTransformer:
+def GPTTiny(vocab_size: int = 1000, max_len: int = 128, mesh=None,
+            dtype: Any = jnp.float32) -> CausalTransformer:
     """Test-sized config."""
     return CausalTransformer(vocab_size=vocab_size, max_len=max_len, embed_dim=64,
-                             depth=2, num_heads=4, mesh=mesh)
+                             depth=2, num_heads=4, mesh=mesh, dtype=dtype)
 
 
-def GPTSmall(vocab_size: int = 32000, max_len: int = 2048, mesh=None) -> CausalTransformer:
+def GPTSmall(vocab_size: int = 32000, max_len: int = 2048, mesh=None,
+             dtype: Any = jnp.float32) -> CausalTransformer:
     """GPT-2-small-ish (124M)."""
     return CausalTransformer(vocab_size=vocab_size, max_len=max_len, embed_dim=768,
-                             depth=12, num_heads=12, mesh=mesh)
+                             depth=12, num_heads=12, mesh=mesh, dtype=dtype)

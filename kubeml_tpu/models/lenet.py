@@ -1,9 +1,13 @@
 """LeNet-5 for MNIST — the reference's smallest demo model
 (reference: ml/experiments/kubeml/function_lenet.py defines the torch LeNet the
 demo function trains). Flax re-implementation with NHWC layout (TPU-native conv
-layout; XLA tiles NHWC convs onto the MXU directly)."""
+layout; XLA tiles NHWC convs onto the MXU directly). ``dtype`` selects the
+computation precision (bf16 compute / f32 params mixed precision); logits are
+always returned f32."""
 
 from __future__ import annotations
+
+from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -11,17 +15,19 @@ import jax.numpy as jnp
 
 class LeNet(nn.Module):
     num_classes: int = 10
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         # x: [B, 28, 28, 1] (or any HxW that survives two 2x2 pools)
-        x = nn.Conv(6, (5, 5), padding="SAME")(x)
+        x = x.astype(self.dtype)
+        x = nn.Conv(6, (5, 5), padding="SAME", dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Conv(16, (5, 5), padding="VALID")(x)
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(120)(x))
-        x = nn.relu(nn.Dense(84)(x))
-        return nn.Dense(self.num_classes)(x)
+        x = nn.relu(nn.Dense(120, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(84, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x).astype(jnp.float32)

@@ -9,12 +9,18 @@ counters too: ml/pkg/model/parallelSGD.go:26-54, utils.go:89-136).
 
 ``cifar_stem=True`` (default) uses the 3x3/stride-1 stem standard for 32x32
 inputs; set False for the ImageNet 7x7/stride-2 + maxpool stem.
+
+``dtype`` is the computation dtype: ``jnp.bfloat16`` runs the convs on the MXU's
+native bf16 passes while parameters stay float32 (mixed precision — the optimizer
+and the K-AVG weight average operate on f32 masters). BatchNorm statistics are
+kept in f32 regardless, and logits are returned as f32 so the loss softmax is
+always computed at full precision.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Sequence, Type
+from typing import Any, Sequence, Type
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -23,20 +29,23 @@ import jax.numpy as jnp
 class BasicBlock(nn.Module):
     filters: int
     strides: int = 1
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                       dtype=jnp.float32)
         residual = x
-        y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides),
-                    padding="SAME", use_bias=False)(x)
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                 padding="SAME")(x)
         y = norm()(y)
         y = nn.relu(y)
-        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(y)
+        y = conv(self.filters, (3, 3), padding="SAME")(y)
         y = norm()(y)
         if residual.shape != y.shape:
-            residual = nn.Conv(self.filters, (1, 1), strides=(self.strides, self.strides),
-                               use_bias=False)(residual)
+            residual = conv(self.filters, (1, 1),
+                            strides=(self.strides, self.strides))(residual)
             residual = norm()(residual)
         return nn.relu(y + residual)
 
@@ -45,21 +54,24 @@ class Bottleneck(nn.Module):
     filters: int
     strides: int = 1
     expansion: int = 4
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                       dtype=jnp.float32)
         residual = x
-        y = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        y = conv(self.filters, (1, 1))(x)
         y = nn.relu(norm()(y))
-        y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides),
-                    padding="SAME", use_bias=False)(y)
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                 padding="SAME")(y)
         y = nn.relu(norm()(y))
-        y = nn.Conv(self.filters * self.expansion, (1, 1), use_bias=False)(y)
+        y = conv(self.filters * self.expansion, (1, 1))(y)
         y = norm()(y)
         if residual.shape != y.shape:
-            residual = nn.Conv(self.filters * self.expansion, (1, 1),
-                               strides=(self.strides, self.strides), use_bias=False)(residual)
+            residual = conv(self.filters * self.expansion, (1, 1),
+                            strides=(self.strides, self.strides))(residual)
             residual = norm()(residual)
         return nn.relu(y + residual)
 
@@ -70,34 +82,44 @@ class ResNet(nn.Module):
     num_classes: int = 10
     num_filters: int = 64
     cifar_stem: bool = True
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9)
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                       dtype=jnp.float32)
+        x = x.astype(self.dtype)
         if self.cifar_stem:
-            x = nn.Conv(self.num_filters, (3, 3), padding="SAME", use_bias=False)(x)
+            x = nn.Conv(self.num_filters, (3, 3), padding="SAME", use_bias=False,
+                        dtype=self.dtype)(x)
             x = nn.relu(norm()(x))
         else:
             x = nn.Conv(self.num_filters, (7, 7), strides=(2, 2), padding="SAME",
-                        use_bias=False)(x)
+                        use_bias=False, dtype=self.dtype)(x)
             x = nn.relu(norm()(x))
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for i, n_blocks in enumerate(self.stage_sizes):
             filters = self.num_filters * 2**i
             for j in range(n_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = self.block(filters, strides=strides)(x, train=train)
-        x = jnp.mean(x, axis=(1, 2))
-        return nn.Dense(self.num_classes)(x)
+                x = self.block(filters, strides=strides, dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x).astype(jnp.float32)
 
 
-def ResNet18(num_classes: int = 10, cifar_stem: bool = True) -> ResNet:
-    return ResNet([2, 2, 2, 2], BasicBlock, num_classes=num_classes, cifar_stem=cifar_stem)
+def ResNet18(num_classes: int = 10, cifar_stem: bool = True,
+             dtype: Any = jnp.float32) -> ResNet:
+    return ResNet([2, 2, 2, 2], BasicBlock, num_classes=num_classes,
+                  cifar_stem=cifar_stem, dtype=dtype)
 
 
-def ResNet34(num_classes: int = 10, cifar_stem: bool = True) -> ResNet:
-    return ResNet([3, 4, 6, 3], BasicBlock, num_classes=num_classes, cifar_stem=cifar_stem)
+def ResNet34(num_classes: int = 10, cifar_stem: bool = True,
+             dtype: Any = jnp.float32) -> ResNet:
+    return ResNet([3, 4, 6, 3], BasicBlock, num_classes=num_classes,
+                  cifar_stem=cifar_stem, dtype=dtype)
 
 
-def ResNet50(num_classes: int = 10, cifar_stem: bool = True) -> ResNet:
-    return ResNet([3, 4, 6, 3], Bottleneck, num_classes=num_classes, cifar_stem=cifar_stem)
+def ResNet50(num_classes: int = 10, cifar_stem: bool = True,
+             dtype: Any = jnp.float32) -> ResNet:
+    return ResNet([3, 4, 6, 3], Bottleneck, num_classes=num_classes,
+                  cifar_stem=cifar_stem, dtype=dtype)

@@ -6,7 +6,7 @@ classifier head sized for 32x32 inputs."""
 
 from __future__ import annotations
 
-from typing import Sequence, Union
+from typing import Any, Sequence, Union
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -21,24 +21,31 @@ class VGG(nn.Module):
     batch_norm: bool = True
     classifier_width: int = 512
     dropout: float = 0.5
+    dtype: Any = jnp.float32  # computation dtype; params stay f32, logits f32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
         for step in self.plan:
             if step == "M":
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
             else:
-                x = nn.Conv(int(step), (3, 3), padding="SAME", use_bias=not self.batch_norm)(x)
+                x = nn.Conv(int(step), (3, 3), padding="SAME",
+                            use_bias=not self.batch_norm, dtype=self.dtype)(x)
                 if self.batch_norm:
-                    x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+                    # BN statistics in f32 regardless of compute dtype
+                    x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                     dtype=jnp.float32)(x)
+                    x = x.astype(self.dtype)
                 x = nn.relu(x)
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(self.classifier_width)(x))
+        x = nn.relu(nn.Dense(self.classifier_width, dtype=self.dtype)(x))
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        x = nn.relu(nn.Dense(self.classifier_width)(x))
+        x = nn.relu(nn.Dense(self.classifier_width, dtype=self.dtype)(x))
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        return nn.Dense(self.num_classes)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x).astype(jnp.float32)
 
 
-def VGG11(num_classes: int = 100, batch_norm: bool = True) -> VGG:
-    return VGG(VGG11_PLAN, num_classes=num_classes, batch_norm=batch_norm)
+def VGG11(num_classes: int = 100, batch_norm: bool = True,
+          dtype: Any = jnp.float32) -> VGG:
+    return VGG(VGG11_PLAN, num_classes=num_classes, batch_norm=batch_norm, dtype=dtype)

@@ -3,9 +3,15 @@
 No counterpart in the reference (CNNs only); built on the shared attention op
 (kubeml_tpu.ops.attention) so the platform can swap in Pallas/ring attention.
 ViT-Tiny defaults: embed 192, depth 12, 3 heads; patch 4 suits 32x32 inputs.
+
+``dtype`` is the computation dtype (bf16 compute / f32 params mixed precision):
+matmuls run in ``dtype``, LayerNorm and the attention softmax stay f32, and
+parameters (incl. cls/pos embeddings) are always stored f32.
 """
 
 from __future__ import annotations
+
+from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -15,33 +21,36 @@ from ..ops.attention import dot_product_attention
 
 class MHSA(nn.Module):
     num_heads: int
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         B, L, E = x.shape
         H = self.num_heads
         D = E // H
-        qkv = nn.DenseGeneral((3, H, D), axis=-1, name="qkv")(x)  # [B, L, 3, H, D]
+        qkv = nn.DenseGeneral((3, H, D), axis=-1, name="qkv",
+                              dtype=self.dtype)(x)  # [B, L, 3, H, D]
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         out = dot_product_attention(q, k, v)
-        return nn.DenseGeneral(E, axis=(-2, -1), name="proj")(out)
+        return nn.DenseGeneral(E, axis=(-2, -1), name="proj", dtype=self.dtype)(out)
 
 
 class EncoderBlock(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
     dropout: float = 0.0
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        y = nn.LayerNorm()(x)
-        y = MHSA(self.num_heads)(y)
+        y = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        y = MHSA(self.num_heads, dtype=self.dtype)(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
-        y = nn.LayerNorm()(x)
-        y = nn.Dense(x.shape[-1] * self.mlp_ratio)(y)
+        y = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        y = nn.Dense(x.shape[-1] * self.mlp_ratio, dtype=self.dtype)(y)
         y = nn.gelu(y)
-        y = nn.Dense(x.shape[-1])(y)
+        y = nn.Dense(x.shape[-1], dtype=self.dtype)(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         return x + y
 
@@ -53,6 +62,7 @@ class ViT(nn.Module):
     depth: int = 12
     num_heads: int = 3
     dropout: float = 0.0
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -60,19 +70,26 @@ class ViT(nn.Module):
         p = self.patch_size
         # patchify via conv: [B, H/p, W/p, E]
         x = nn.Conv(self.embed_dim, (p, p), strides=(p, p), padding="VALID",
-                    name="patch_embed")(x)
+                    name="patch_embed", dtype=self.dtype)(x.astype(self.dtype))
         x = x.reshape((B, -1, self.embed_dim))
-        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.embed_dim), x.dtype)
-        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, self.embed_dim)), x], axis=1)
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.embed_dim),
+                         jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(x.dtype), (B, 1, self.embed_dim)), x], axis=1
+        )
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
-                         (1, x.shape[1], self.embed_dim), x.dtype)
-        x = x + pos
+                         (1, x.shape[1], self.embed_dim), jnp.float32)
+        x = x + pos.astype(x.dtype)
         for _ in range(self.depth):
-            x = EncoderBlock(self.num_heads, dropout=self.dropout)(x, train=train)
-        x = nn.LayerNorm()(x)
-        return nn.Dense(self.num_classes)(x[:, 0])
+            x = EncoderBlock(self.num_heads, dropout=self.dropout,
+                             dtype=self.dtype)(x, train=train)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(
+            x[:, 0].astype(self.dtype)
+        ).astype(jnp.float32)
 
 
-def ViTTiny(num_classes: int = 100, patch_size: int = 4) -> ViT:
+def ViTTiny(num_classes: int = 100, patch_size: int = 4,
+            dtype: Any = jnp.float32) -> ViT:
     return ViT(num_classes=num_classes, patch_size=patch_size,
-               embed_dim=192, depth=12, num_heads=3)
+               embed_dim=192, depth=12, num_heads=3, dtype=dtype)

@@ -125,17 +125,19 @@ class MoEBlock(nn.Module):
     dropout: float = 0.0
     mesh: Optional[object] = None  # jax.sharding.Mesh; for sp attention
     sp_impl: str = "ring"
+    dtype: object = jnp.float32  # computation dtype (router stays f32)
 
     @nn.compact
     def __call__(self, x, valid, train: bool = False):
         from ..models.gpt import CausalSelfAttention
 
-        y = nn.LayerNorm(name="ln1")(x)
+        y = nn.LayerNorm(name="ln1", dtype=jnp.float32)(x).astype(self.dtype)
         y = CausalSelfAttention(self.num_heads, mesh=self.mesh,
-                                sp_impl=self.sp_impl, name="attn")(y, valid)
+                                sp_impl=self.sp_impl, dtype=self.dtype,
+                                name="attn")(y, valid)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
-        y = nn.LayerNorm(name="ln2")(x)
+        y = nn.LayerNorm(name="ln2", dtype=jnp.float32)(x).astype(self.dtype)
         y = MoEMlp(
             num_experts=self.num_experts,
             mlp_ratio=self.mlp_ratio,
