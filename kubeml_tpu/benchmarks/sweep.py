@@ -1,0 +1,230 @@
+"""Experiment sweep harness — the reference's K / parallelism / batch grids.
+
+The reference's thesis experiments sweep K ∈ {1,2,4,8,16,32,64,−1},
+parallelism ∈ {2,4,8,16} and batch ∈ {16,32,64,128} over 30-50 epochs and plot
+time-to-accuracy / epoch-time / accuracy-vs-global-batch from the recorded
+histories (reference: ml/experiments/app/time_to_accuracy.py:40-86,
+ml/experiments/train.py:15,76-80; SURVEY §6 sweep grid). This module drives the
+same grids through the live scheduler → PS → job path (ExperimentDriver, the
+port of ml/experiments/common/experiment.py:82-182) and emits one record per
+grid point: accuracy trace, epoch times, samples/sec, and time-to-goal — the
+inputs behind every figure family in the reference's `ml/experiments/figures/`.
+
+Usage:
+    python -m kubeml_tpu.benchmarks.sweep --quick                  # CI-sized grid
+    python -m kubeml_tpu.benchmarks.sweep --scenario resnet18-cifar10 \
+        --goal-accuracy 70 --out sweep.json --csv sweep.csv        # full grid
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import io
+import json
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+from ..api.config import Config
+from .scenarios import ExperimentDriver, Scenario, scenarios
+
+# The reference grids (SURVEY §6). K=-1 is sparse averaging (one sync/epoch).
+FULL_GRID_K: Sequence[int] = (1, 2, 4, 8, 16, 32, 64, -1)
+FULL_GRID_PARALLELISM: Sequence[int] = (2, 4, 8, 16)
+FULL_GRID_BATCH: Sequence[int] = (16, 32, 64, 128)
+
+# CI-sized grid: every axis exercised (incl. sparse averaging) but small enough
+# that each new parallelism level compiles once on a 1-core CPU host.
+QUICK_GRID_K: Sequence[int] = (1, 4, -1)
+QUICK_GRID_PARALLELISM: Sequence[int] = (1, 2)
+QUICK_GRID_BATCH: Sequence[int] = (16,)
+
+
+@dataclass
+class SweepPoint:
+    """One grid point's outcome (one training job)."""
+
+    scenario: str
+    k: int
+    parallelism: int
+    batch_size: int
+    global_batch: int  # parallelism * batch_size — x-axis of accuracy-vs-global-batch
+    job_id: str = ""
+    epochs: int = 0
+    accuracy: List[float] = field(default_factory=list)
+    train_loss: List[float] = field(default_factory=list)
+    epoch_seconds: List[float] = field(default_factory=list)
+    samples_per_sec: float = 0.0
+    # cumulative training seconds until the goal accuracy was first reached;
+    # None = goal not reached (or no goal set) — the reference's TTA metric
+    time_to_accuracy: Optional[float] = None
+    status: str = "ok"
+    error: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+def grid(quick: bool = True) -> List[Tuple[int, int, int]]:
+    """(k, parallelism, batch) tuples for the sweep."""
+    ks = QUICK_GRID_K if quick else FULL_GRID_K
+    ps = QUICK_GRID_PARALLELISM if quick else FULL_GRID_PARALLELISM
+    bs = QUICK_GRID_BATCH if quick else FULL_GRID_BATCH
+    return [(k, p, b) for p in ps for b in bs for k in ks]
+
+
+def _point_request(sc: Scenario, quick: bool, k: int, p: int, b: int,
+                   epochs: Optional[int], goal_accuracy: Optional[float]):
+    req = copy.deepcopy(sc.quick_request if quick else sc.request)
+    req.batch_size = b
+    req.options.k = k
+    req.options.default_parallelism = p
+    # grid points pin parallelism (the reference sweeps fixed parallelism per
+    # run and plots elastic behavior separately)
+    req.options.static_parallelism = True
+    if epochs is not None:
+        req.epochs = epochs
+    if goal_accuracy is not None:
+        req.options.goal_accuracy = goal_accuracy
+        req.options.validate_every = 1  # TTA needs per-epoch validation
+    return req
+
+
+def _time_to_accuracy(accuracy: List[float], epoch_seconds: List[float],
+                      goal: Optional[float]) -> Optional[float]:
+    if goal is None:
+        return None
+    elapsed = 0.0
+    for acc, dt in zip(accuracy, epoch_seconds):
+        elapsed += dt
+        if acc >= goal:
+            return elapsed
+    return None
+
+
+def run_sweep(
+    scenario_name: str = "lenet-mnist",
+    quick: bool = True,
+    points: Optional[Sequence[Tuple[int, int, int]]] = None,
+    epochs: Optional[int] = None,
+    goal_accuracy: Optional[float] = None,
+    config: Optional[Config] = None,
+    driver: Optional[ExperimentDriver] = None,
+) -> List[SweepPoint]:
+    """Run the grid for one scenario; returns one SweepPoint per (k, p, b)."""
+    from ..api.config import get_config
+
+    scs = {s.name: s for s in scenarios()}
+    if scenario_name not in scs:
+        raise ValueError(f"unknown scenario {scenario_name!r}; known: {sorted(scs)}")
+    sc = scs[scenario_name]
+    pts = list(points if points is not None else grid(quick))
+
+    own_driver = driver is None
+    if own_driver:
+        cfg = config or get_config()
+        cfg.ensure_dirs()
+        driver = ExperimentDriver(cfg)
+    results: List[SweepPoint] = []
+    try:
+        driver.prepare(sc, quick)
+        for k, p, b in pts:
+            req = _point_request(sc, quick, k, p, b, epochs, goal_accuracy)
+            point = SweepPoint(scenario=sc.name, k=k, parallelism=p,
+                               batch_size=b, global_batch=p * b)
+            t0 = time.time()
+            try:
+                job_id = driver.scheduler.submit_train(req)
+                point.job_id = job_id
+                if not driver.wait(job_id):
+                    point.status, point.error = "timeout", "job did not finish"
+                    results.append(point)
+                    continue
+                hist = driver.history_store.get(job_id)
+                err = driver._job_error(hist)
+                n_train = driver.store.get(req.dataset).num_samples("train")
+                point.epochs = len(hist.train_loss)
+                point.accuracy = hist.accuracy
+                point.train_loss = hist.train_loss
+                point.epoch_seconds = hist.epoch_duration
+                total = n_train * len(hist.train_loss)
+                point.samples_per_sec = total / max(sum(hist.epoch_duration), 1e-9)
+                point.time_to_accuracy = _time_to_accuracy(
+                    hist.accuracy, hist.epoch_duration,
+                    goal_accuracy if goal_accuracy is not None
+                    else (req.options.goal_accuracy
+                          if req.options.goal_accuracy < 1000.0 else None),
+                )
+                if err:
+                    point.status, point.error = "failed", err
+            except Exception as e:  # a broken grid point must not kill the sweep
+                point.status, point.error = "error", str(e)
+            finally:
+                point_wall = time.time() - t0
+                if not point.epoch_seconds:
+                    point.epoch_seconds = [point_wall]
+            results.append(point)
+    finally:
+        if own_driver:
+            driver.close()
+    return results
+
+
+def to_csv(points: Sequence[SweepPoint]) -> str:
+    """Flat CSV (one row per grid point) for pandas/spreadsheet analysis —
+    the sweep's equivalent of the reference's pandas persistence
+    (ml/experiments/common/experiment.py pandas DataFrames)."""
+    out = io.StringIO()
+    cols = ["scenario", "k", "parallelism", "batch_size", "global_batch",
+            "job_id", "epochs", "final_accuracy", "final_train_loss",
+            "mean_epoch_seconds", "samples_per_sec", "time_to_accuracy", "status"]
+    out.write(",".join(cols) + "\n")
+    for p in points:
+        row = [
+            p.scenario, p.k, p.parallelism, p.batch_size, p.global_batch,
+            p.job_id, p.epochs,
+            round(p.accuracy[-1], 4) if p.accuracy else "",
+            round(p.train_loss[-1], 6) if p.train_loss else "",
+            round(sum(p.epoch_seconds) / len(p.epoch_seconds), 3)
+            if p.epoch_seconds else "",
+            round(p.samples_per_sec, 1),
+            round(p.time_to_accuracy, 3) if p.time_to_accuracy is not None else "",
+            p.status,
+        ]
+        out.write(",".join(str(c) for c in row) + "\n")
+    return out.getvalue()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="kubeml-tpu K/parallelism/batch sweep")
+    p.add_argument("--scenario", default="lenet-mnist",
+                   help="scenario name (see benchmarks.scenarios)")
+    p.add_argument("--quick", action="store_true", help="CI-sized grid and data")
+    p.add_argument("--epochs", type=int, default=None, help="override epochs per point")
+    p.add_argument("--goal-accuracy", type=float, default=None,
+                   help="record time-to-accuracy against this goal (percent)")
+    p.add_argument("--out", default=None, help="write results JSON here")
+    p.add_argument("--csv", default=None, help="write flat CSV here")
+    args = p.parse_args(argv)
+    try:
+        results = run_sweep(args.scenario, quick=args.quick, epochs=args.epochs,
+                            goal_accuracy=args.goal_accuracy)
+    except ValueError as e:
+        print(f"error: {e}", file=__import__("sys").stderr)
+        return 2
+    payload = [r.to_dict() for r in results]
+    print(json.dumps(payload, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=2)
+    if args.csv:
+        with open(args.csv, "w") as f:
+            f.write(to_csv(results))
+    return 1 if any(r.status != "ok" for r in results) else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

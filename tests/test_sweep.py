@@ -1,0 +1,56 @@
+"""Sweep harness tests (kubeml_tpu.benchmarks.sweep) — the K/parallelism/batch
+grid driver mirroring the reference's experiment sweeps (SURVEY §6)."""
+
+import numpy as np
+
+from kubeml_tpu.benchmarks.sweep import (
+    FULL_GRID_BATCH,
+    FULL_GRID_K,
+    FULL_GRID_PARALLELISM,
+    SweepPoint,
+    grid,
+    run_sweep,
+    to_csv,
+)
+
+
+def test_full_grid_matches_reference_axes():
+    pts = grid(quick=False)
+    assert len(pts) == len(FULL_GRID_K) * len(FULL_GRID_PARALLELISM) * len(FULL_GRID_BATCH)
+    ks = {k for k, _, _ in pts}
+    assert ks == set(FULL_GRID_K)
+    assert -1 in ks  # sparse averaging is part of the reference grid
+
+
+def test_sweep_runs_grid_points_and_records_tta(tmp_config):
+    # two points covering both K extremes and both parallelism levels; a goal
+    # low enough that the synthetic task reaches it in epoch 1, so the TTA
+    # metric is exercised
+    points = [(1, 1, 16), (-1, 2, 16)]
+    results = run_sweep("lenet-mnist", quick=True, points=points,
+                        goal_accuracy=5.0, config=tmp_config)
+    assert [(p.k, p.parallelism, p.batch_size) for p in results] == points
+    for p in results:
+        assert p.status == "ok", p.error
+        assert p.epochs >= 1
+        assert p.accuracy and np.isfinite(p.accuracy[-1])
+        assert p.global_batch == p.parallelism * p.batch_size
+        assert p.time_to_accuracy is not None
+        assert p.time_to_accuracy <= sum(p.epoch_seconds) + 1e-6
+
+
+def test_to_csv_shape():
+    pt = SweepPoint(scenario="s", k=4, parallelism=2, batch_size=16,
+                    global_batch=32, job_id="j", epochs=2,
+                    accuracy=[10.0, 20.0], train_loss=[1.0, 0.5],
+                    epoch_seconds=[1.0, 1.1], samples_per_sec=123.4,
+                    time_to_accuracy=2.1)
+    csv = to_csv([pt])
+    lines = csv.strip().split("\n")
+    assert len(lines) == 2
+    header, row = lines
+    assert header.split(",")[0:5] == ["scenario", "k", "parallelism",
+                                     "batch_size", "global_batch"]
+    cells = row.split(",")
+    assert cells[0] == "s" and cells[1] == "4" and cells[4] == "32"
+    assert cells[header.split(",").index("status")] == "ok"
