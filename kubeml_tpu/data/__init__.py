@@ -1,3 +1,4 @@
 from .dataset import KubeDataset, TrainParams  # noqa: F401
 from .sharding import RoundPlan, plan_epoch, plan_eval, split_minibatches, subset_period  # noqa: F401
 from .loader import RoundBatch, RoundLoader, build_round, validation_loader  # noqa: F401
+from . import transforms  # noqa: F401

@@ -45,14 +45,17 @@ class KubeDataset:
     The runtime attaches the storage handle before any task runs; user code only
     names the dataset::
 
+        from kubeml_tpu.data import transforms as T
+
         class Cifar(KubeDataset):
             def __init__(self):
                 super().__init__("cifar10")
 
             def transform(self, x, y):
                 if self.is_training():
-                    x = random_crop_flip(x)
-                return normalize(x), y
+                    x = T.random_crop(x, padding=4)
+                    x = T.random_horizontal_flip(x)
+                return T.normalize(x, T.CIFAR10_MEAN, T.CIFAR10_STD), y
     """
 
     def __init__(self, dataset_name: str):

@@ -1,0 +1,111 @@
+"""Batched transform tests (kubeml_tpu.data.transforms)."""
+
+import numpy as np
+import pytest
+
+from kubeml_tpu.data import transforms as T
+
+
+@pytest.fixture
+def imgs(rng):
+    return rng.normal(size=(16, 32, 32, 3)).astype(np.float32)
+
+
+def test_normalize_roundtrip(imgs):
+    out = T.normalize(imgs, T.CIFAR10_MEAN, T.CIFAR10_STD)
+    assert out.shape == imgs.shape
+    back = out * np.asarray(T.CIFAR10_STD, np.float32) + np.asarray(T.CIFAR10_MEAN, np.float32)
+    np.testing.assert_allclose(back, imgs, rtol=1e-5, atol=1e-5)
+
+
+def test_normalize_casts_integer_input():
+    x = np.arange(8, dtype=np.uint8).reshape(1, 2, 2, 2)
+    out = T.normalize(x, (0.0, 0.0), (1.0, 1.0))
+    assert np.issubdtype(out.dtype, np.floating)
+    np.testing.assert_allclose(out.reshape(-1), np.arange(8))
+
+
+def test_random_crop_matches_per_item_reference(imgs):
+    """The vectorized stride-tricks gather must equal the obvious per-item
+    pad-then-slice implementation under the same offsets."""
+    pad = 4
+    g = np.random.default_rng(7)
+    out = T.random_crop(imgs, padding=pad, rng=np.random.default_rng(7))
+    b, h, w, c = imgs.shape
+    oh = g.integers(0, 2 * pad + 1, size=b)
+    ow = g.integers(0, 2 * pad + 1, size=b)
+    padded = np.pad(imgs, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    for i in range(b):
+        ref = padded[i, oh[i]:oh[i] + h, ow[i]:ow[i] + w]
+        np.testing.assert_array_equal(out[i], ref)
+
+
+def test_random_crop_zero_padding_is_identity(imgs):
+    assert T.random_crop(imgs, padding=0) is imgs
+
+
+def test_random_horizontal_flip_flips_only_selected(imgs):
+    out = T.random_horizontal_flip(imgs, p=1.0, rng=np.random.default_rng(0))
+    np.testing.assert_array_equal(out, imgs[:, :, ::-1])
+    out = T.random_horizontal_flip(imgs, p=0.0, rng=np.random.default_rng(0))
+    np.testing.assert_array_equal(out, imgs)
+
+
+def test_cutout_zeroes_one_square(imgs):
+    size = 8
+    out = T.cutout(imgs, size=size, rng=np.random.default_rng(3))
+    assert out.shape == imgs.shape
+    changed = (out != imgs).any(axis=-1)  # [B, H, W]
+    for i in range(imgs.shape[0]):
+        n = changed[i].sum()
+        # the square may be clipped at the border but never exceeds size^2
+        assert 0 < n <= size * size
+        # changed pixels are exactly zero
+        assert np.all(out[i][changed[i]] == 0.0)
+
+
+def test_cutout_does_not_mutate_input(imgs):
+    before = imgs.copy()
+    T.cutout(imgs, size=4)
+    np.testing.assert_array_equal(imgs, before)
+
+
+def test_compose_and_recipes(imgs):
+    tf = T.cifar_train_transform(rng=np.random.default_rng(0))
+    out = tf(imgs)
+    assert out.shape == imgs.shape
+    ev = T.cifar_eval_transform()
+    np.testing.assert_allclose(
+        ev(imgs), T.normalize(imgs, T.CIFAR10_MEAN, T.CIFAR10_STD)
+    )
+
+
+def test_transform_hook_integration(tmp_config, rng):
+    """A KubeDataset using the transforms module behaves per mode flag."""
+    from kubeml_tpu.data.dataset import KubeDataset
+    from kubeml_tpu.storage.store import ShardStore
+
+    class Ds(KubeDataset):
+        def __init__(self):
+            super().__init__("blobs")
+
+        def transform(self, x, y):
+            if self.is_training():
+                x = T.random_horizontal_flip(x, p=1.0)
+            return T.normalize(x, T.MNIST_MEAN, T.MNIST_STD), y
+
+    store = ShardStore(config=tmp_config)
+    x = rng.normal(size=(64, 8, 8, 1)).astype(np.float32)
+    y = rng.integers(0, 4, size=64).astype(np.int64)
+    store.create("blobs", x, y, x[:16], y[:16])
+    ds = Ds()
+    ds._attach(store)
+
+    ds.set_mode(True)
+    tx, _ = ds.transform(x, y)
+    np.testing.assert_allclose(
+        tx, T.normalize(x[:, :, ::-1], T.MNIST_MEAN, T.MNIST_STD), rtol=1e-5
+    )
+    ds.set_mode(False)
+    vx, _ = ds.transform(x, y)
+    np.testing.assert_allclose(vx, T.normalize(x, T.MNIST_MEAN, T.MNIST_STD), rtol=1e-5)
