@@ -1,0 +1,5 @@
+from .torch_import import (  # noqa: F401
+    conv_kernel_from_torch,
+    import_hf_bert,
+    linear_kernel_from_torch,
+)

@@ -1,0 +1,136 @@
+"""PyTorch checkpoint import — bring reference-world models into kubeml-tpu.
+
+The reference's users write torch models and its platform stores torch weights
+(reference: python/kubeml/kubeml/network.py:444-461 pushes ``state_dict``
+tensors). A migrating user's most valuable asset is a trained torch
+checkpoint, so this module converts them to this framework's flax variable
+pytrees:
+
+* generic layout converters (`linear_kernel_from_torch`,
+  `conv_kernel_from_torch`) for hand-built mappings — torch stores Linear
+  weights ``[out, in]`` and Conv2d weights ``[O, I, kH, kW]``; flax wants
+  ``[in, out]`` and HWIO ``[kH, kW, I, O]`` (NHWC/TPU layout);
+* `import_hf_bert` — a complete mapping from a HuggingFace
+  ``BertForSequenceClassification`` state_dict onto
+  :class:`kubeml_tpu.models.bert.BertClassifier` variables, so BASELINE
+  target #4 (BERT SST-2 fine-tune) can start from a real pretrained encoder
+  instead of random init.
+
+Everything operates on plain numpy extracted from the state_dict — torch is
+only touched by the caller; no torch import happens here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+
+def _np(t: Any) -> np.ndarray:
+    """Accept torch tensors or arrays without importing torch."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, np.float32)
+
+
+def linear_kernel_from_torch(weight: Any) -> np.ndarray:
+    """torch ``nn.Linear.weight`` [out, in] → flax Dense kernel [in, out]."""
+    return _np(weight).T
+
+
+def conv_kernel_from_torch(weight: Any) -> np.ndarray:
+    """torch ``nn.Conv2d.weight`` [O, I, kH, kW] → flax Conv kernel HWIO
+    [kH, kW, I, O] (the NHWC/TPU conv layout the model zoo uses)."""
+    return np.transpose(_np(weight), (2, 3, 1, 0))
+
+
+def _dense_general(weight: Any, bias: Any, heads: int, head_dim: int, *,
+                   out_heads: bool) -> Dict[str, np.ndarray]:
+    """HF [E, E] attention projection → our DenseGeneral shapes.
+
+    out_heads=True: q/k/v projections, kernel [E, H, D], bias [H, D].
+    out_heads=False: output projection, kernel [H, D, E], bias [E]."""
+    w = linear_kernel_from_torch(weight)  # [in, out]
+    e_in, e_out = w.shape
+    if out_heads:
+        return {"kernel": w.reshape(e_in, heads, head_dim),
+                "bias": _np(bias).reshape(heads, head_dim)}
+    return {"kernel": w.reshape(heads, head_dim, e_out), "bias": _np(bias)}
+
+
+def _layer_norm(sd: Mapping[str, Any], prefix: str) -> Dict[str, np.ndarray]:
+    return {"scale": _np(sd[f"{prefix}.weight"]), "bias": _np(sd[f"{prefix}.bias"])}
+
+
+def _dense(sd: Mapping[str, Any], prefix: str) -> Dict[str, np.ndarray]:
+    return {"kernel": linear_kernel_from_torch(sd[f"{prefix}.weight"]),
+            "bias": _np(sd[f"{prefix}.bias"])}
+
+
+def import_hf_bert(state_dict: Mapping[str, Any], model) -> Dict[str, Any]:
+    """Map a HuggingFace ``BertForSequenceClassification`` state_dict onto a
+    :class:`~kubeml_tpu.models.bert.BertClassifier`'s variables.
+
+    ``model`` is the target BertClassifier (its config must match the
+    checkpoint: depth, heads, embed_dim, mlp_dim, vocab, max_len). Returns a
+    fresh ``{"params": ...}`` pytree shaped exactly like ``model.init``'s.
+
+    Architectural deltas handled here:
+    * HF adds word + position + token-type embeddings; this model has no
+      token-type input, so the type-0 embedding row is folded into the
+      position embeddings (single-segment equivalence).
+    * HF prefixes may or may not include the leading ``bert.`` (encoder-only
+      dumps); both are accepted.
+    """
+    sd = dict(state_dict)
+    if not any(k.startswith("bert.") for k in sd):
+        sd = {f"bert.{k}" if not k.startswith("classifier") else k: v
+              for k, v in sd.items()}
+
+    H = model.num_heads
+    D = model.embed_dim // H
+
+    word = _np(sd["bert.embeddings.word_embeddings.weight"])  # [V, E]
+    pos = _np(sd["bert.embeddings.position_embeddings.weight"])  # [max_len, E]
+    type0 = _np(sd["bert.embeddings.token_type_embeddings.weight"])[0]  # [E]
+    if word.shape != (model.vocab_size, model.embed_dim):
+        raise ValueError(
+            f"checkpoint vocab/embed {word.shape} != model "
+            f"({model.vocab_size}, {model.embed_dim})"
+        )
+    if pos.shape[0] < model.max_len:
+        raise ValueError(
+            f"checkpoint max positions {pos.shape[0]} < model.max_len {model.max_len}"
+        )
+
+    params: Dict[str, Any] = {
+        "token_embed": {"embedding": word},
+        "pos_embed": (pos[: model.max_len] + type0[None, :])[None],  # [1, L, E]
+        "LayerNorm_0": _layer_norm(sd, "bert.embeddings.LayerNorm"),
+        "pooler": _dense(sd, "bert.pooler.dense"),
+        "Dense_0": _dense(sd, "classifier"),
+    }
+    for i in range(model.depth):
+        hf = f"bert.encoder.layer.{i}"
+        params[f"BertLayer_{i}"] = {
+            "BertSelfAttention_0": {
+                "query": _dense_general(sd[f"{hf}.attention.self.query.weight"],
+                                        sd[f"{hf}.attention.self.query.bias"],
+                                        H, D, out_heads=True),
+                "key": _dense_general(sd[f"{hf}.attention.self.key.weight"],
+                                      sd[f"{hf}.attention.self.key.bias"],
+                                      H, D, out_heads=True),
+                "value": _dense_general(sd[f"{hf}.attention.self.value.weight"],
+                                        sd[f"{hf}.attention.self.value.bias"],
+                                        H, D, out_heads=True),
+                "output": _dense_general(sd[f"{hf}.attention.output.dense.weight"],
+                                         sd[f"{hf}.attention.output.dense.bias"],
+                                         H, D, out_heads=False),
+            },
+            "LayerNorm_0": _layer_norm(sd, f"{hf}.attention.output.LayerNorm"),
+            "Dense_0": _dense(sd, f"{hf}.intermediate.dense"),
+            "Dense_1": _dense(sd, f"{hf}.output.dense"),
+            "LayerNorm_1": _layer_norm(sd, f"{hf}.output.LayerNorm"),
+        }
+    return {"params": params}

@@ -51,7 +51,9 @@ class BertLayer(nn.Module):
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = nn.LayerNorm(dtype=jnp.float32)(x + y).astype(self.dtype)
         y = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
-        y = nn.gelu(y)
+        # exact (erf) gelu — BERT's convention, and required for checkpoint
+        # interop parity (kubeml_tpu.interop.torch_import)
+        y = nn.gelu(y, approximate=False)
         y = nn.Dense(x.shape[-1], dtype=self.dtype)(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         return nn.LayerNorm(dtype=jnp.float32)(x + y).astype(self.dtype)
