@@ -154,7 +154,7 @@ class KAvgTrainer:
         """Initialize one replica and broadcast it across the worker axis, placed
         sharded over the mesh (the reference's init function publishing reference
         weights to Redis, network.py:174-189)."""
-        sample = self._cast_input(jnp.asarray(sample_x))
+        sample = self.model.preprocess(self._cast_input(jnp.asarray(sample_x)))
         variables = self.model.init(rng, sample)
         stacked = _broadcast_to_workers(variables, n_workers)
         sharded, _ = self._shardings(n_workers)
@@ -230,7 +230,9 @@ class KAvgTrainer:
             return vars_f, worker_loss, active
 
         def sync_round(stacked_vars, x, y, mask, worker_mask, rng):
-            x = self._cast_input(x)
+            # device-side input pipeline: cast floats to the compute precision,
+            # then the model's preprocess hook (e.g. uint8 -> scaled bf16)
+            x = model.preprocess(self._cast_input(x))
             rngs = jax.random.split(rng, n_workers)
             # pre-round reference: replicas are identical at round start (post
             # previous sync / init broadcast) — the fallback when no worker is
@@ -314,7 +316,7 @@ class KAvgTrainer:
         model = self.model
 
         def eval_fn(variables, x, y, mask):
-            x = self._cast_input(x)
+            x = model.preprocess(self._cast_input(x))
             flat_x = x.reshape((-1,) + x.shape[3:])
             flat_y = y.reshape((-1,) + y.shape[3:])
             flat_m = mask.reshape(-1)
@@ -367,4 +369,8 @@ class KAvgTrainer:
 
     def infer(self, stacked_vars, x: np.ndarray):
         variables = jax.tree.map(lambda v: v[0], stacked_vars)
-        return np.asarray(self.model.infer(variables, self._cast_input(jnp.asarray(x))))
+        return np.asarray(
+            self.model.infer(
+                variables, self.model.preprocess(self._cast_input(jnp.asarray(x)))
+            )
+        )

@@ -81,6 +81,9 @@ class SPMDJob:
             optimizer=model.configure_optimizers(),
             precision=request.options.precision,
             donate=request.options.donate,
+            # the KubeModel device-side input pipeline (runtime/model.py
+            # preprocess) applies under this engine too, not just K-AVG
+            input_transform=model.preprocess,
         )
 
         self.history = History(id=job_id, task={"request": request.to_dict()})
@@ -276,7 +279,6 @@ class SPMDJob:
         import jax.numpy as jnp
 
         with self._step_lock, jax.set_mesh(self.mesh):
-            logits = self.model.module.apply(
-                self.trainer.params, jnp.asarray(np.asarray(x), jnp.int32), train=False
-            )
+            tokens = self.model.preprocess(jnp.asarray(np.asarray(x), jnp.int32))
+            logits = self.model.module.apply(self.trainer.params, tokens, train=False)
             return np.asarray(jnp.argmax(logits, axis=-1))

@@ -52,6 +52,7 @@ class SPMDTrainer:
         precision: str = "bf16",
         batch_spec: P = P("dp", "sp"),
         donate: bool = True,
+        input_transform: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None,
     ):
         self.module = module
         self.mesh = mesh
@@ -60,6 +61,9 @@ class SPMDTrainer:
         self.precision = precision
         self.batch_spec = batch_spec
         self.donate = donate
+        # device-side input pipeline hook traced into the step (the KubeModel
+        # preprocess contract, runtime/model.py — e.g. uint8 dequantization)
+        self.input_transform = input_transform
         self._step_fn = None
         self.params = None
         self.opt_state = None
@@ -68,6 +72,8 @@ class SPMDTrainer:
 
     def init(self, rng: jax.Array, sample_batch: np.ndarray) -> None:
         sample = jnp.asarray(sample_batch)
+        if self.input_transform is not None:
+            sample = self.input_transform(sample)
         abstract = jax.eval_shape(lambda r: self.module.init(r, sample, train=False), rng)
         specs = nn.get_partition_spec(abstract)
         param_shardings = jax.tree.map(
@@ -102,11 +108,13 @@ class SPMDTrainer:
         module = self.module
         tx = self.tx
         loss_fn = self.loss_fn
-        cast = (
+        base_cast = (
             (lambda x: x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating) else x)
             if self.precision == "bf16"
             else (lambda x: x)
         )
+        transform = self.input_transform
+        cast = (lambda x: transform(base_cast(x))) if transform is not None else base_cast
 
         def step(variables, opt_state, batch, rng):
             def compute_loss(params):
@@ -153,6 +161,9 @@ class SPMDTrainer:
     # --- eval ---
 
     def eval_loss(self, batch: np.ndarray) -> float:
+        x = jnp.asarray(batch)
+        if self.input_transform is not None:
+            x = self.input_transform(x)
         with jax.set_mesh(self.mesh):
-            logits = self.module.apply(self.params, jnp.asarray(batch), train=False)
+            logits = self.module.apply(self.params, x, train=False)
             return float(self.loss_fn(jnp.asarray(logits, jnp.float32), jnp.asarray(batch)))

@@ -568,7 +568,11 @@ class ParameterServer:
         model, variables = cached[0], cached[1]
         self.metrics.task_started("inference")
         try:
-            x = jnp.asarray(np.asarray(data))
+            # same device-side input pipeline as training/live serving: a model
+            # whose preprocess dequantizes (KubeModel.preprocess) must see
+            # identical inputs whether the job is live (KAvgTrainer.infer) or
+            # served from its final checkpoint here
+            x = model.preprocess(jnp.asarray(np.asarray(data)))
             return np.asarray(model.infer(variables, x)).tolist()
         finally:
             self.metrics.task_finished("inference")

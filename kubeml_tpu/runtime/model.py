@@ -118,6 +118,19 @@ class KubeModel(ABC):
         logits = self.module.apply(variables, x, train=train, rngs=rngs)
         return logits, {}
 
+    def preprocess(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Device-side input preprocessing, traced into the jitted step (default
+        identity). Override to run normalization on device so the host can
+        stage quantized inputs — e.g. stage uint8 images and scale here::
+
+            def preprocess(self, x):
+                return x.astype(jnp.bfloat16) / 127.5 - 1.0
+
+        which cuts host->HBM bytes 4x vs f32 (2x vs bf16) — the standard TPU
+        input-pipeline pattern. Host-side (numpy) augmentation belongs in
+        ``KubeDataset.transform``; this hook is for the final cast/scale."""
+        return x
+
     def per_sample_loss(self, logits: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
         """Per-sample losses [B]; default integer-label softmax cross-entropy."""
         return optax.softmax_cross_entropy_with_integer_labels(logits, y)

@@ -305,6 +305,50 @@ class TestClusterEndToEnd:
         text = requests.get(f"{cluster.ps_api.url}/metrics", timeout=5).text
         assert "kubeml_job_running_total" in text
 
+    def test_checkpoint_serving_applies_preprocess(self, cluster):
+        """Post-finish inference (served from the final checkpoint) must run
+        the model's device-side preprocess exactly like live inference: a
+        uint8-dequant model's served predictions have to match predictions
+        computed locally from the exported weights WITH preprocess applied."""
+        import jax.numpy as jnp
+
+        from kubeml_tpu.controller.client import KubemlClient
+        from kubeml_tpu.storage.checkpoint import CheckpointStore
+
+        fn_quant = FN_SOURCE.replace(
+            "    def configure_optimizers(self):",
+            "    def preprocess(self, x):\n"
+            "        import jax.numpy as jnp\n"
+            "        return x.astype(jnp.float32) / 127.5 - 1.0\n\n"
+            "    def configure_optimizers(self):",
+        )
+        client = KubemlClient(cluster.controller_url)
+        r = np.random.default_rng(0)
+        y = r.integers(0, 4, size=256).astype(np.int64)
+        x = np.clip(r.normal(size=(256, 8, 8, 1)) * 30 + 128 + 20 * y[:, None, None, None],
+                    0, 255).astype(np.uint8)
+        client.datasets().create("blobs", x, y, x[:64], y[:64])
+        client.functions().create("quant", fn_quant)
+        req = TrainRequest(
+            batch_size=16, epochs=2, dataset="blobs", lr=0.05, function_name="quant",
+            options=TrainOptions(default_parallelism=1, k=2, static_parallelism=True),
+        )
+        job_id = client.networks().train(req)
+        _wait_done(client, job_id)
+
+        probe = x[:8]
+        served = np.asarray(client.networks().infer(job_id, probe))
+
+        # local reference: exported weights + preprocess applied by hand
+        from kubeml_tpu.api.config import get_config
+        from kubeml_tpu.functions.registry import FunctionRegistry
+
+        ck = CheckpointStore(config=get_config()).restore(job_id, tag="final")
+        model = FunctionRegistry(config=get_config()).load("quant")
+        pre = model.preprocess(jnp.asarray(probe))
+        expected = np.asarray(model.infer(ck.variables, pre))
+        np.testing.assert_array_equal(served, expected)
+
     def test_concurrent_jobs_stress(self, cluster):
         """Race-condition stress over the live HTTP surface: 5 jobs submitted
         from concurrent threads against one shared dataset/function, one

@@ -269,3 +269,35 @@ def test_plan_eval_bounded_rounds():
     plan = plan_eval(num_docs=100, n_workers=2, batch_size=32, max_steps_per_round=8)
     assert plan.steps_per_round == 8
     assert plan.num_rounds == 13  # ceil(50*64 / (8*32))
+
+
+def test_uint8_staged_preprocess_pipeline():
+    """uint8 inputs stage unchanged and the model's device-side preprocess
+    dequantizes inside the jitted round: training must match the same data fed
+    as pre-scaled floats (the uint8 path halves->quarters host->HBM bytes)."""
+
+    class QuantModel(TinyModel):
+        def preprocess(self, x):
+            return x.astype(jnp.float32) / 127.5 - 1.0
+
+    n, steps, b, dim = 2, 2, 8, 8
+    r = np.random.default_rng(5)
+    xq = r.integers(0, 256, size=(n, steps, b, dim)).astype(np.uint8)
+    y = r.integers(0, 4, size=(n, steps, b)).astype(np.int32)
+    m = np.ones((n, steps, b), np.float32)
+    rng = jax.random.PRNGKey(0)
+
+    qt = KAvgTrainer(QuantModel(), precision="f32")
+    vq = qt.init_variables(rng, xq[0, 0], n)
+    sx, sy, sm = qt.stage_round(xq, y, m, n)
+    assert sx.dtype == jnp.uint8  # staged quantized, not upcast on host
+    vq, loss_q = qt.sync_round(vq, sx, sy, sm, rng, lr=0.1)
+
+    ft = KAvgTrainer(TinyModel(), precision="f32")
+    xf = (xq.astype(np.float32) / 127.5 - 1.0)
+    vf = ft.init_variables(rng, xf[0, 0], n)
+    vf, loss_f = ft.sync_round(vf, xf, y, m, rng, lr=0.1)
+
+    np.testing.assert_allclose(float(loss_q), float(loss_f), rtol=1e-5)
+    for a, b_ in zip(jax.tree.leaves(vq), jax.tree.leaves(vf)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-5, atol=1e-6)

@@ -1,0 +1,14 @@
+"""Long-context benchmark harness smoke test (CI-sized)."""
+
+from kubeml_tpu.benchmarks.longcontext import run_point
+
+
+def test_run_point_tiny():
+    res = run_point(seq_len=64, tokens_per_step=128, steps=1, dtype_name="f32",
+                    depth=2, embed_dim=32, num_heads=2, vocab=64)
+    assert res["unit"] == "tokens/sec"
+    assert res["value"] > 0
+    assert res["seq_len"] == 64
+    import math
+
+    assert math.isfinite(res["loss"])
