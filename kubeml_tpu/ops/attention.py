@@ -23,10 +23,18 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-# Auto-dispatch threshold, measured on TPU v5e (bench in git history): XLA's
-# fused attention wins at short L (4.8 vs 9.9 ms at L=1024) but falls off the
-# L^2-in-HBM cliff at long context (flash is 3x faster at L=4096, 18x at
-# L=8192). Structured-mask callers below this KV length keep the XLA path.
+# Auto-dispatch threshold for the Pallas flash kernel, tuned on the TRAINING
+# path, re-measured on v5e with a reliable value-fetch barrier (2026-07-30).
+# The isolated attention op favors XLA at every length (fwd+bwd B=4 H=8 D=64
+# bf16 causal, xla vs pallas ms/step: L=1024: 14.6/10.2, L=2048: 13.7/14.9,
+# L=4096: 27.7/32.5, L=8192: 82.9/104.8) — but inside a full rematerialized
+# training step (GPT 8x512, jax.checkpoint, 16k-token steps) the ordering
+# flips hard at long context, because remat recomputes the backward's
+# attention and XLA's fusion then materializes the L^2 scores through HBM
+# while the flash custom call recomputes tiles in VMEM. Measured end-to-end
+# tokens/sec, xla vs pallas: L=1024: 145k/127k, L=2048: 103k/91k,
+# L=4096: 15.4k/54.4k (3.5x), L=8192: 4.1k/29.4k (7.3x). Structured-mask
+# callers at KV length >= this threshold get the kernel; None disables.
 FLASH_MIN_KV_LEN = 4096
 
 
@@ -48,7 +56,8 @@ def dot_product_attention(
     if impl is None:
         impl = (
             "pallas"
-            if mask is None
+            if FLASH_MIN_KV_LEN is not None
+            and mask is None
             and jax.default_backend() == "tpu"
             and k.shape[1] >= FLASH_MIN_KV_LEN
             else "xla"
