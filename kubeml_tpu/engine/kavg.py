@@ -162,13 +162,15 @@ class KAvgTrainer:
 
     def resize(self, stacked_vars, old_n: int, new_n: int):
         """Elastic re-mesh between epochs: replicas are identical after a sync, so
-        take replica 0 and re-broadcast onto the new mesh."""
+        take replica 0 and re-broadcast onto the new mesh. The reshard is a direct
+        device_put between shardings — device-to-device over ICI, no host bounce
+        of the model."""
         if old_n == new_n:
             return stacked_vars
         one = jax.tree.map(lambda x: x[0], stacked_vars)
         stacked = _broadcast_to_workers(one, new_n)
         sharded, _ = self._shardings(new_n)
-        return jax.device_put(jax.tree.map(np.asarray, stacked), sharded)
+        return jax.device_put(stacked, sharded)
 
     def place_reference(self, variables, n_workers: int):
         """Broadcast one reference replica (e.g. a restored checkpoint) across the
