@@ -208,3 +208,34 @@ class TestSPMDTrainer:
         tr.init(jax.random.PRNGKey(0), batch)
         loss = float(tr.train_step(batch, jax.random.PRNGKey(1)))
         assert np.isfinite(loss)
+
+
+def test_spmd_input_transform_applied():
+    """SPMDTrainer must trace the KubeModel preprocess contract into the step
+    and eval: a transform that maps every token to PAD must produce a
+    different loss than the identity (same weights, same raw batch)."""
+    import jax.numpy as jnp
+
+    from kubeml_tpu.models.gpt import GPTTiny
+    from kubeml_tpu.parallel.trainer import SPMDTrainer
+
+    mesh = make_mesh(dp=8)
+    r = np.random.default_rng(0)
+    batch = r.integers(1, 50, size=(8, 16)).astype(np.int32)
+    rng = jax.random.PRNGKey(0)
+
+    plain = SPMDTrainer(GPTTiny(vocab_size=50, max_len=16, mesh=mesh), mesh,
+                        precision="f32")
+    plain.init(rng, batch)
+    base_eval = plain.eval_loss(batch)
+
+    shifted = SPMDTrainer(GPTTiny(vocab_size=50, max_len=16, mesh=mesh), mesh,
+                          precision="f32",
+                          input_transform=lambda x: jnp.where(x > 0, 1, 0))
+    shifted.init(rng, batch)
+    tr_eval = shifted.eval_loss(batch)
+    assert np.isfinite(base_eval) and np.isfinite(tr_eval)
+    assert abs(base_eval - tr_eval) > 1e-6  # the transform visibly changed inputs
+
+    loss = float(shifted.train_step(batch, rng))
+    assert np.isfinite(loss)
