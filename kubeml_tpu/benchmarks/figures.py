@@ -1,0 +1,189 @@
+"""Benchmark figure rendering — the reference's experiment figure families.
+
+The reference's results live as thesis figures (reference:
+ml/experiments/figures/paper/{lenet,resnet34}/: tta*.pdf,
+batch-vs-time-by-{k,parallelism}.pdf, global-batch-vs-acc.pdf; BASELINE/SURVEY
+§6). This module renders the same families from sweep results
+(kubeml_tpu.benchmarks.sweep JSON), closing the experiments-harness loop:
+
+    python -m kubeml_tpu.benchmarks.sweep --quick --out sweep.json
+    python -m kubeml_tpu.benchmarks.figures sweep.json --outdir figures/
+
+Design notes (dataviz method): categorical hues come from a validated palette
+in its fixed slot order and follow the entity (a K value keeps its hue across
+figures), one y-axis per chart, thin 2px lines / ≥6pt markers, recessive grid,
+text in neutral ink rather than series colors, legend whenever there are >= 2
+series.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+# validated categorical palette (reference instance, fixed slot order — slot i
+# is always assigned to the i-th DISTINCT series key, sorted, so a given K /
+# parallelism value keeps its hue across every figure of one report)
+CATEGORICAL = ["#2a78d6", "#eb6834", "#1baf7a", "#eda100", "#e87ba4",
+               "#008300", "#4a3aa7", "#e34948"]
+INK = "#1a1a19"       # primary text
+MUTED = "#6b6b68"     # secondary text / axes
+GRID = "#e6e6e3"      # recessive gridlines
+SURFACE = "#fcfcfb"
+
+
+def _style(ax, title: str, xlabel: str, ylabel: str) -> None:
+    ax.set_facecolor(SURFACE)
+    ax.set_title(title, color=INK, fontsize=11, loc="left")
+    ax.set_xlabel(xlabel, color=MUTED, fontsize=9)
+    ax.set_ylabel(ylabel, color=MUTED, fontsize=9)
+    ax.tick_params(colors=MUTED, labelsize=8)
+    ax.grid(True, color=GRID, linewidth=0.8, zorder=0)
+    for side in ("top", "right"):
+        ax.spines[side].set_visible(False)
+    for side in ("left", "bottom"):
+        ax.spines[side].set_color(GRID)
+
+
+def _series_colors(keys: Sequence) -> Dict:
+    """Fixed-order hue assignment: i-th distinct (sorted) key -> slot i."""
+    ordered = sorted(set(keys), key=lambda k: (isinstance(k, str), k))
+    if len(ordered) > len(CATEGORICAL):
+        raise ValueError(
+            f"{len(ordered)} series exceed the categorical palette; "
+            "facet or fold the tail into 'other' instead of cycling hues"
+        )
+    return {k: CATEGORICAL[i] for i, k in enumerate(ordered)}
+
+
+def _label_k(k: int) -> str:
+    return "K=-1 (sparse)" if k == -1 else f"K={k}"
+
+
+def _ok(points: List[dict]) -> List[dict]:
+    return [p for p in points if p.get("status") == "ok"]
+
+
+def fig_time_by(points: List[dict], series_field: str, out: Path,
+                series_label=lambda v: str(v)) -> Optional[Path]:
+    """Mean epoch seconds vs batch size, one line per K (or parallelism) —
+    the reference's batch-vs-time-by-{k,parallelism} family."""
+    import matplotlib.pyplot as plt
+
+    pts = _ok(points)
+    if not pts:
+        return None
+    colors = _series_colors([p[series_field] for p in pts])
+    fig, ax = plt.subplots(figsize=(6, 4), dpi=150)
+    fig.patch.set_facecolor(SURFACE)
+    for key, color in colors.items():
+        rows = sorted((p for p in pts if p[series_field] == key),
+                      key=lambda p: p["batch_size"])
+        xs = [p["batch_size"] for p in rows]
+        ys = [sum(p["epoch_seconds"]) / max(len(p["epoch_seconds"]), 1) for p in rows]
+        ax.plot(xs, ys, color=color, linewidth=2, marker="o", markersize=4,
+                label=series_label(key), zorder=3)
+    _style(ax, f"Epoch time vs batch size, by {series_field}",
+           "batch size (per worker)", "mean epoch seconds")
+    if len(colors) >= 2:
+        ax.legend(fontsize=8, labelcolor=INK, frameon=False)
+    fig.tight_layout()
+    fig.savefig(out, facecolor=SURFACE)
+    plt.close(fig)
+    return out
+
+
+def fig_tta(points: List[dict], out: Path) -> Optional[Path]:
+    """Time-to-accuracy per parallelism level (the reference's tta* family).
+    Only grid points that reached the goal appear."""
+    import matplotlib.pyplot as plt
+
+    pts = [p for p in _ok(points) if p.get("time_to_accuracy") is not None]
+    if not pts:
+        return None
+    # best (minimum) TTA per parallelism level across K/batch
+    best: Dict[int, float] = {}
+    for p in pts:
+        lvl = p["parallelism"]
+        best[lvl] = min(best.get(lvl, float("inf")), p["time_to_accuracy"])
+    levels = sorted(best)
+    fig, ax = plt.subplots(figsize=(6, 4), dpi=150)
+    fig.patch.set_facecolor(SURFACE)
+    # single series (magnitude) -> one hue, not one color per bar
+    ax.bar([str(l) for l in levels], [best[l] for l in levels],
+           color=CATEGORICAL[0], width=0.6, zorder=3)
+    for i, l in enumerate(levels):
+        ax.text(i, best[l], f" {best[l]:.1f}s", color=MUTED, fontsize=8,
+                ha="center", va="bottom")
+    _style(ax, "Best time-to-accuracy by parallelism", "parallelism",
+           "seconds to goal accuracy")
+    fig.tight_layout()
+    fig.savefig(out, facecolor=SURFACE)
+    plt.close(fig)
+    return out
+
+
+def fig_global_batch_acc(points: List[dict], out: Path) -> Optional[Path]:
+    """Final accuracy vs global batch (parallelism x batch) — the reference's
+    global-batch-vs-acc family; one line per K."""
+    import matplotlib.pyplot as plt
+
+    pts = [p for p in _ok(points) if p.get("accuracy")]
+    if not pts:
+        return None
+    colors = _series_colors([p["k"] for p in pts])
+    fig, ax = plt.subplots(figsize=(6, 4), dpi=150)
+    fig.patch.set_facecolor(SURFACE)
+    for key, color in colors.items():
+        rows = sorted((p for p in pts if p["k"] == key),
+                      key=lambda p: p["global_batch"])
+        ax.plot([p["global_batch"] for p in rows],
+                [p["accuracy"][-1] for p in rows],
+                color=color, linewidth=2, marker="o", markersize=4,
+                label=_label_k(key), zorder=3)
+    ax.set_xscale("log", base=2)
+    _style(ax, "Final accuracy vs global batch", "global batch (log2)",
+           "final validation accuracy (%)")
+    if len(colors) >= 2:
+        ax.legend(fontsize=8, labelcolor=INK, frameon=False)
+    fig.tight_layout()
+    fig.savefig(out, facecolor=SURFACE)
+    plt.close(fig)
+    return out
+
+
+def render_all(points: List[dict], outdir: Path) -> List[Path]:
+    outdir = Path(outdir)
+    outdir.mkdir(parents=True, exist_ok=True)
+    made = [
+        fig_time_by(points, "k", outdir / "batch-vs-time-by-k.png", _label_k),
+        fig_time_by(points, "parallelism", outdir / "batch-vs-time-by-parallelism.png",
+                    lambda v: f"p={v}"),
+        fig_tta(points, outdir / "tta.png"),
+        fig_global_batch_acc(points, outdir / "global-batch-vs-acc.png"),
+    ]
+    return [m for m in made if m is not None]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="render benchmark figures from sweep JSON")
+    ap.add_argument("sweep_json", help="output of benchmarks.sweep --out")
+    ap.add_argument("--outdir", default="figures")
+    args = ap.parse_args(argv)
+    import matplotlib
+
+    matplotlib.use("Agg")
+    with open(args.sweep_json) as f:
+        points = json.load(f)
+    made = render_all(points, Path(args.outdir))
+    for m in made:
+        print(m)
+    return 0 if made else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

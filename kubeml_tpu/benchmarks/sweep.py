@@ -206,6 +206,8 @@ def main(argv=None) -> int:
                    help="record time-to-accuracy against this goal (percent)")
     p.add_argument("--out", default=None, help="write results JSON here")
     p.add_argument("--csv", default=None, help="write flat CSV here")
+    p.add_argument("--figures", default=None,
+                   help="render the reference figure families into this dir")
     args = p.parse_args(argv)
     try:
         results = run_sweep(args.scenario, quick=args.quick, epochs=args.epochs,
@@ -221,6 +223,13 @@ def main(argv=None) -> int:
     if args.csv:
         with open(args.csv, "w") as f:
             f.write(to_csv(results))
+    if args.figures:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        from .figures import render_all
+
+        render_all(payload, args.figures)
     return 1 if any(r.status != "ok" for r in results) else 0
 
 

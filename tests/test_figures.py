@@ -1,0 +1,66 @@
+"""Figure rendering tests (kubeml_tpu.benchmarks.figures)."""
+
+import json
+
+import pytest
+
+from kubeml_tpu.benchmarks.figures import _series_colors, render_all
+
+
+def _pt(k, p, b, acc, secs, tta=None, status="ok"):
+    return {
+        "scenario": "s", "k": k, "parallelism": p, "batch_size": b,
+        "global_batch": p * b, "job_id": "j", "epochs": len(secs),
+        "accuracy": acc, "train_loss": [1.0] * len(secs),
+        "epoch_seconds": secs, "samples_per_sec": 10.0,
+        "time_to_accuracy": tta, "status": status,
+    }
+
+
+@pytest.fixture
+def points():
+    return [
+        _pt(1, 1, 16, [20.0, 40.0], [1.0, 1.1], tta=2.1),
+        _pt(4, 1, 32, [25.0, 45.0], [0.8, 0.9], tta=1.7),
+        _pt(-1, 2, 16, [22.0, 42.0], [0.7, 0.75], tta=1.45),
+        _pt(4, 2, 32, [30.0, 50.0], [0.6, 0.65]),
+        _pt(1, 2, 16, [0.0], [1.0], status="error"),
+    ]
+
+
+def test_render_all_produces_figures(tmp_path, points):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    made = render_all(points, tmp_path / "figs")
+    names = sorted(m.name for m in made)
+    assert names == ["batch-vs-time-by-k.png", "batch-vs-time-by-parallelism.png",
+                     "global-batch-vs-acc.png", "tta.png"]
+    for m in made:
+        assert m.stat().st_size > 1000  # a real rendered PNG, not an empty file
+
+
+def test_render_all_empty_is_graceful(tmp_path):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    assert render_all([], tmp_path / "figs") == []
+
+
+def test_series_colors_fixed_order_and_cap():
+    colors = _series_colors([4, 1, -1, 4])
+    # sorted distinct keys -> fixed slots: -1, 1, 4
+    assert list(colors) == [-1, 1, 4]
+    assert len(set(colors.values())) == 3
+    with pytest.raises(ValueError):
+        _series_colors(list(range(20)))
+
+
+def test_main_cli(tmp_path, points):
+    from kubeml_tpu.benchmarks.figures import main
+
+    src = tmp_path / "sweep.json"
+    src.write_text(json.dumps(points))
+    out = tmp_path / "figs"
+    assert main([str(src), "--outdir", str(out)]) == 0
+    assert (out / "tta.png").exists()

@@ -17,7 +17,11 @@ from kubeml_tpu.benchmarks.scenarios import (
 def test_synthetic_generators():
     x, y = synth_images(32, (28, 28, 1), 10, seed=0)
     assert x.shape == (32, 28, 28, 1) and y.shape == (32,)
-    assert x.dtype == np.float32 and 0 <= y.min() and y.max() < 10
+    # quantized at rest like real image datasets; dequant happens on device
+    assert x.dtype == np.uint8 and 0 <= y.min() and y.max() < 10
+    # the class signal (brightest 2-row band) survives quantization
+    band_means = x[:, :20].astype(np.float32).reshape(32, 10, -1).mean(axis=2)
+    assert (band_means.argmax(axis=1) == y).mean() > 0.9
     t, ty = synth_tokens(16, 24, 100, 2, seed=0)
     assert t.shape == (16, 24) and (t[:, -2:] == 0).all()
     assert set(np.unique(ty)) <= {0, 1}
