@@ -108,3 +108,26 @@ class WorkerHealth:
         re-mesh worker indices are renumbered, so bookkeeping resets."""
         dead = len([w for w in self._persistent if w < current])
         return max(1, current - dead)
+
+
+# Error substrings that mark a TRANSIENT accelerator/runtime fault rather than
+# a program bug: XLA/PJRT RPC-layer failures (remote compile service drops,
+# preempted/unavailable backends). Rounds hitting these are retried with
+# backoff (engine/job.py) the way the reference retries its start-task RPC
+# 10x with backoff (reference: ml/pkg/ps/api.go:192-207); anything else
+# propagates immediately.
+TRANSIENT_ERROR_MARKERS = (
+    "INTERNAL:",
+    "UNAVAILABLE:",
+    "DEADLINE_EXCEEDED",
+    "remote_compile",
+    "response body closed",
+    "Connection reset",
+    "preempted",
+)
+
+
+def is_transient_accelerator_error(exc: BaseException) -> bool:
+    """True when the exception text matches a known transient fault marker."""
+    msg = f"{type(exc).__name__}: {exc}"
+    return any(marker in msg for marker in TRANSIENT_ERROR_MARKERS)

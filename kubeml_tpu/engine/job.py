@@ -298,22 +298,7 @@ class TrainJob:
                     continue
             with self.tracer.span("job.round", job=self.job_id, epoch=epoch,
                                   round=rb.round_index):
-                # async-stage the slabs (bf16 host cast + device_put): the
-                # transfer rides the DMA engine while the previous round's
-                # compute is still in flight
-                sx, sy, sm = self.trainer.stage_round(
-                    rb.x, rb.y, rb.mask, self.parallelism
-                )
-                self._stacked_vars, loss = self.trainer.sync_round(
-                    self._stacked_vars,
-                    sx,
-                    sy,
-                    sm,
-                    jax.random.fold_in(rng, rb.round_index),
-                    lr=req.lr,
-                    epoch=epoch,
-                    worker_mask=worker_mask,
-                )
+                loss = self._run_round(rb, rng, worker_mask, epoch)
             losses.append(loss)
         if not losses:
             if self.stop_event.is_set():
@@ -332,6 +317,56 @@ class TrainJob:
         # one blocking host read per epoch, not per round (keeps rounds async);
         # a NaN here is real divergence and stays visible in the history
         return float(np.mean([float(l) for l in losses]))
+
+    def _run_round(self, rb, rng, worker_mask, epoch: int):
+        """One staged sync round, retried on transient accelerator faults.
+
+        The dev tunnel's remote-compile RPC (and real fleets' preemptions) can
+        drop mid-round; retrying re-stages and re-runs the round — safe because
+        a failed round never published averaged weights. Semantic errors
+        (KubeMLError/MergeError) propagate immediately."""
+        from .failures import is_transient_accelerator_error
+
+        req = self.request
+        attempts = 3
+        for attempt in range(attempts):
+            try:
+                # async-stage the slabs (bf16 host cast / quantized uint8 +
+                # device_put): the transfer rides the DMA engine while the
+                # previous round's compute is still in flight
+                sx, sy, sm = self.trainer.stage_round(
+                    rb.x, rb.y, rb.mask, self.parallelism
+                )
+                self._stacked_vars, loss = self.trainer.sync_round(
+                    self._stacked_vars,
+                    sx,
+                    sy,
+                    sm,
+                    jax.random.fold_in(rng, rb.round_index),
+                    lr=req.lr,
+                    epoch=epoch,
+                    worker_mask=worker_mask,
+                )
+                return loss
+            except KubeMLError:
+                raise
+            except Exception as e:
+                # the variables buffer is donated into sync_round: if the
+                # failed execution already consumed it there is nothing left
+                # to retry with — only retry while every leaf is still alive
+                alive = all(
+                    not getattr(leaf, "is_deleted", lambda: False)()
+                    for leaf in jax.tree.leaves(self._stacked_vars)
+                )
+                if (attempt == attempts - 1 or not alive
+                        or not is_transient_accelerator_error(e)):
+                    raise
+                log.warning(
+                    "%s: transient accelerator error on round %d (attempt %d/%d), "
+                    "retrying: %s", self.job_id, rb.round_index, attempt + 1,
+                    attempts, e,
+                )
+                time.sleep(1.0 + attempt)
 
     def _validate(self, dataset: KubeDataset, handle):
         dataset.set_mode(False)

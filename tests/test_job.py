@@ -170,3 +170,49 @@ def test_non_divisor_batch_size_trains(mnist_store, tmp_config):
                    history_store=HistoryStore(config=tmp_config))
     hist = job.train()
     assert len(hist.train_loss) == 1
+
+
+def test_transient_accelerator_error_retried(mnist_store, tmp_config):
+    """A round that fails with a transient RPC-style fault (e.g. the remote
+    compile service dropping the connection) is retried and the job completes;
+    a non-transient error still fails the job immediately."""
+    from kubeml_tpu.engine.failures import is_transient_accelerator_error
+
+    assert is_transient_accelerator_error(
+        RuntimeError("INTERNAL: http://x/remote_compile: read body: "
+                     "response body closed before all bytes were read"))
+    assert not is_transient_accelerator_error(ValueError("bad shapes"))
+
+    job = TrainJob(
+        "retryjob", _request(epochs=1, options=dict(default_parallelism=1, k=2,
+                                                    static_parallelism=True)),
+        KubeLeNet(), store=mnist_store, history_store=HistoryStore(config=tmp_config),
+    )
+    real = job.trainer.sync_round
+    calls = {"n": 0}
+
+    def flaky(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise RuntimeError("UNAVAILABLE: backend preempted")
+        return real(*a, **kw)
+
+    job.trainer.sync_round = flaky
+    hist = job.train()
+    assert len(hist.train_loss) == 1
+    assert calls["n"] >= 3  # two transient failures were retried
+
+    job2 = TrainJob(
+        "failjob", _request(epochs=1, options=dict(default_parallelism=1, k=2,
+                                                   static_parallelism=True)),
+        KubeLeNet(), store=mnist_store, history_store=HistoryStore(config=tmp_config),
+    )
+
+    def broken(*a, **kw):
+        raise RuntimeError("some real bug")
+
+    job2.trainer.sync_round = broken
+    from kubeml_tpu.api.errors import KubeMLError
+
+    with pytest.raises(KubeMLError):
+        job2.train()
