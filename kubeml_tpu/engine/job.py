@@ -273,7 +273,21 @@ class TrainJob:
         rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch + 1)
         losses = []
         skipped = 0
-        for rb in loader:
+        # double-buffered staging: each round's slabs are device_put one round
+        # ahead, so the host->HBM transfer of round i+1 overlaps round i's
+        # compute (stage_round never blocks; parallelism is fixed within an
+        # epoch so the ahead-staging target sharding is always right)
+        rounds_it = iter(loader)
+        current = next(rounds_it, None)
+        staged = None if current is None else self.trainer.stage_round(
+            current.x, current.y, current.mask, self.parallelism
+        )
+        while current is not None:
+            rb, rb_staged = current, staged
+            current = next(rounds_it, None)
+            staged = None if current is None else self.trainer.stage_round(
+                current.x, current.y, current.mask, self.parallelism
+            )
             if self.stop_event.is_set():
                 break
             worker_mask = None
@@ -298,7 +312,7 @@ class TrainJob:
                     continue
             with self.tracer.span("job.round", job=self.job_id, epoch=epoch,
                                   round=rb.round_index):
-                loss = self._run_round(rb, rng, worker_mask, epoch)
+                loss = self._run_round(rb, rng, worker_mask, epoch, staged=rb_staged)
             losses.append(loss)
         if not losses:
             if self.stop_event.is_set():
@@ -318,12 +332,14 @@ class TrainJob:
         # a NaN here is real divergence and stays visible in the history
         return float(np.mean([float(l) for l in losses]))
 
-    def _run_round(self, rb, rng, worker_mask, epoch: int):
+    def _run_round(self, rb, rng, worker_mask, epoch: int, staged=None):
         """One staged sync round, retried on transient accelerator faults.
 
-        The dev tunnel's remote-compile RPC (and real fleets' preemptions) can
-        drop mid-round; retrying re-stages and re-runs the round — safe because
-        a failed round never published averaged weights. Semantic errors
+        ``staged`` carries slabs already ahead-staged by the epoch loop's
+        double buffer; retries always re-stage from the host arrays. The dev
+        tunnel's remote-compile RPC (and real fleets' preemptions) can drop
+        mid-round; retrying re-stages and re-runs the round — safe because a
+        failed round never published averaged weights. Semantic errors
         (KubeMLError/MergeError) propagate immediately."""
         from .failures import is_transient_accelerator_error
 
@@ -334,9 +350,12 @@ class TrainJob:
                 # async-stage the slabs (bf16 host cast / quantized uint8 +
                 # device_put): the transfer rides the DMA engine while the
                 # previous round's compute is still in flight
-                sx, sy, sm = self.trainer.stage_round(
-                    rb.x, rb.y, rb.mask, self.parallelism
-                )
+                if staged is not None and attempt == 0:
+                    sx, sy, sm = staged
+                else:
+                    sx, sy, sm = self.trainer.stage_round(
+                        rb.x, rb.y, rb.mask, self.parallelism
+                    )
                 self._stacked_vars, loss = self.trainer.sync_round(
                     self._stacked_vars,
                     sx,
