@@ -1,0 +1,38 @@
+"""Causal LM under the SPMD mesh engine (no reference counterpart).
+
+Deploy and train with the mesh spec on the request:
+
+    python -m kubeml_tpu.cli function create -n lm --code examples/function_gpt_spmd.py
+    python -m kubeml_tpu.cli train -f lm -d tokens -e 10 -b 64 --lr 3e-4 \
+        --engine spmd --mesh tp=2,sp=2
+
+The dataset is a token-id array [N, L] (id 0 = padding). ``build()`` reads
+``self.mesh`` (attached by the engine) so attention can run ring/Ulysses
+sequence-parallel over ``sp`` and matmuls tensor-parallel over ``tp``."""
+
+import jax.numpy as jnp
+import optax
+
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.models.gpt import CausalTransformer
+from kubeml_tpu.runtime.model import KubeModel
+
+
+class Tokens(KubeDataset):
+    def __init__(self):
+        super().__init__("tokens")
+
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(Tokens())
+
+    def build(self):
+        return CausalTransformer(
+            vocab_size=32000, max_len=2048, embed_dim=768, depth=12,
+            num_heads=12, mesh=self.mesh, sp_impl="ring", remat=True,
+            dtype=jnp.bfloat16,
+        )
+
+    def configure_optimizers(self):
+        return optax.adamw(self.lr, weight_decay=0.1)

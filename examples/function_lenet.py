@@ -1,0 +1,30 @@
+"""LeNet-5 on MNIST — the smallest complete kubeml-tpu function
+(counterpart of reference ml/experiments/kubeml/function_lenet.py)."""
+
+import jax.numpy as jnp
+import optax
+
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.models.lenet import LeNet
+from kubeml_tpu.runtime.model import KubeModel
+
+
+class Mnist(KubeDataset):
+    def __init__(self):
+        super().__init__("mnist")
+
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(Mnist())
+
+    def build(self):
+        return LeNet(num_classes=10)
+
+    def preprocess(self, x):
+        # dataset stored uint8: dequantize on device (x/255, MNIST-normalized)
+        x = x.astype(jnp.float32) / 255.0
+        return (x - 0.1307) / 0.3081
+
+    def configure_optimizers(self):
+        return optax.sgd(self.lr, momentum=0.9)

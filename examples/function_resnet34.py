@@ -1,0 +1,51 @@
+"""ResNet-34 on CIFAR-10 — the reference's canonical benchmark function
+(counterpart of ml/experiments/kubeml/function_resnet34.py: torchvision
+transforms switched on train/val, epoch-based LR decay at function_resnet34.py:52-63).
+
+Here the same recipe is split by where it runs best: augmentation on the host
+slab (quantized bytes), normalization on device, LR decay via the epoch-aware
+optimizer hook."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from kubeml_tpu.data import transforms as T
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.models.resnet import ResNet34
+from kubeml_tpu.runtime.model import KubeModel
+
+
+class Cifar10(KubeDataset):
+    def __init__(self):
+        super().__init__("cifar10")
+
+    def transform(self, x, y):
+        # the torchvision train recipe, vectorized over the whole round slab;
+        # val mode passes the bytes straight through (normalize is on device)
+        if self.is_training():
+            x = T.random_crop(x, padding=4)
+            x = T.random_horizontal_flip(x)
+        return x, y
+
+
+class Model(KubeModel):
+    # configure_optimizers reads self.epoch -> retrace per epoch
+    epoch_in_schedule = True
+
+    def __init__(self):
+        super().__init__(Cifar10())
+
+    def build(self):
+        return ResNet34(num_classes=10)
+
+    def preprocess(self, x):
+        x = x.astype(jnp.float32) / 255.0
+        mean = jnp.asarray(T.CIFAR10_MEAN)
+        std = jnp.asarray(T.CIFAR10_STD)
+        return (x - mean) / std
+
+    def configure_optimizers(self):
+        # the reference decays lr /10 at epochs 25 and 40
+        lr = self.lr * (0.1 ** int(np.searchsorted([25, 40], self.epoch, side="right")))
+        return optax.sgd(lr, momentum=0.9)

@@ -1,0 +1,37 @@
+"""VGG-11 on CIFAR-100 (counterpart of reference
+ml/experiments/kubeml/function_vgg11.py; BASELINE sweep config
+app/time_to_accuracy.py:53-59)."""
+
+import jax.numpy as jnp
+import optax
+
+from kubeml_tpu.data import transforms as T
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.models.vgg import VGG11
+from kubeml_tpu.runtime.model import KubeModel
+
+
+class Cifar100(KubeDataset):
+    def __init__(self):
+        super().__init__("cifar100")
+
+    def transform(self, x, y):
+        if self.is_training():
+            x = T.random_crop(x, padding=4)
+            x = T.random_horizontal_flip(x)
+        return x, y
+
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(Cifar100())
+
+    def build(self):
+        return VGG11(num_classes=100)
+
+    def preprocess(self, x):
+        x = x.astype(jnp.float32) / 255.0
+        return (x - jnp.asarray(T.CIFAR100_MEAN)) / jnp.asarray(T.CIFAR100_STD)
+
+    def configure_optimizers(self):
+        return optax.sgd(self.lr, momentum=0.9)

@@ -1,0 +1,38 @@
+"""ViT-Tiny on CIFAR-100 (BASELINE target #3 — no reference counterpart;
+the reference era is CNN-only)."""
+
+import jax.numpy as jnp
+import optax
+
+from kubeml_tpu.data import transforms as T
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.models.vit import ViTTiny
+from kubeml_tpu.runtime.model import KubeModel
+
+
+class Cifar100(KubeDataset):
+    def __init__(self):
+        super().__init__("cifar100")
+
+    def transform(self, x, y):
+        if self.is_training():
+            x = T.random_crop(x, padding=4)
+            x = T.random_horizontal_flip(x)
+            x = T.cutout(x, size=8)
+        return x, y
+
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(Cifar100())
+
+    def build(self):
+        # bf16 compute: the HBM/bandwidth lever for transformer matmuls
+        return ViTTiny(num_classes=100, dtype=jnp.bfloat16)
+
+    def preprocess(self, x):
+        x = x.astype(jnp.float32) / 255.0
+        return (x - jnp.asarray(T.CIFAR100_MEAN)) / jnp.asarray(T.CIFAR100_STD)
+
+    def configure_optimizers(self):
+        return optax.adamw(self.lr, weight_decay=0.05)

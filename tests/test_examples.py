@@ -1,0 +1,83 @@
+"""The shipped example functions must actually deploy through the registry and
+expose working hooks; the smallest one trains end-to-end."""
+
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from kubeml_tpu.functions.registry import FunctionRegistry
+
+EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
+
+
+@pytest.fixture
+def registry(tmp_config):
+    return FunctionRegistry(config=tmp_config)
+
+
+@pytest.mark.parametrize("name", ["function_lenet", "function_resnet34",
+                                  "function_vgg11", "function_vit",
+                                  "function_gpt_spmd"])
+def test_example_deploys_and_builds(registry, name):
+    source = (EXAMPLES / f"{name}.py").read_text()
+    registry.create(name, source)
+    model = registry.load(name)
+    module = model.module  # build() succeeds (mesh=None path)
+    assert module is not None
+    tx = model.configure_optimizers()
+    assert hasattr(tx, "update")
+    if name != "function_gpt_spmd":  # image models: uint8 device pipeline
+        import jax.numpy as jnp
+
+        x = jnp.asarray(np.random.default_rng(0).integers(
+            0, 256, size=(2, 8, 8, 3 if name != "function_lenet" else 1)), jnp.uint8)
+        out = model.preprocess(x)
+        assert jnp.issubdtype(out.dtype, jnp.floating)
+        assert float(jnp.abs(out).max()) < 30.0  # roughly normalized
+
+
+def test_example_resnet34_epoch_decay(registry):
+    source = (EXAMPLES / "function_resnet34.py").read_text()
+    registry.create("function_resnet34", source)
+    model = registry.load("function_resnet34")
+    assert model.epoch_in_schedule
+    model.lr = 0.1
+    lrs = []
+    for epoch in (0, 24, 25, 39, 40):
+        model.epoch = epoch
+        model.configure_optimizers()
+        lr = model.lr * (0.1 ** int(np.searchsorted([25, 40], epoch, side="right")))
+        lrs.append(lr)
+    assert lrs == [0.1, 0.1, pytest.approx(0.01), pytest.approx(0.01),
+                   pytest.approx(0.001)]
+
+
+def test_example_lenet_trains(registry, tmp_config):
+    """The LeNet example runs a real 1-epoch job over the uint8 pipeline."""
+    from kubeml_tpu.api.types import TrainOptions, TrainRequest
+    from kubeml_tpu.engine.job import TrainJob
+    from kubeml_tpu.storage import HistoryStore, ShardStore
+
+    source = (EXAMPLES / "function_lenet.py").read_text()
+    registry.create("function_lenet", source)
+    model = registry.load("function_lenet")
+
+    store = ShardStore(config=tmp_config)
+    r = np.random.default_rng(0)
+    y = r.integers(0, 10, size=(256,)).astype(np.int64)
+    x = np.clip(r.normal(110, 40, size=(256, 28, 28, 1))
+                + 40 * (y[:, None, None, None] % 3), 0, 255).astype(np.uint8)
+    store.create("mnist", x, y, x[:64], y[:64])
+
+    req = TrainRequest(
+        model_type="function_lenet", function_name="function_lenet",
+        dataset="mnist", batch_size=32, epochs=1, lr=0.05,
+        options=TrainOptions(default_parallelism=1, k=2, static_parallelism=True),
+    )
+    job = TrainJob("exjob", req, model, store=store,
+                   history_store=HistoryStore(config=tmp_config))
+    hist = job.train()
+    assert len(hist.train_loss) == 1 and np.isfinite(hist.train_loss[0])
+    assert hist.accuracy and np.isfinite(hist.accuracy[-1])
