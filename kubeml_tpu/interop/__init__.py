@@ -1,5 +1,6 @@
 from .torch_import import (  # noqa: F401
     conv_kernel_from_torch,
+    export_hf_bert,
     import_hf_bert,
     linear_kernel_from_torch,
 )

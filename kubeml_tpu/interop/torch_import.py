@@ -134,3 +134,62 @@ def import_hf_bert(state_dict: Mapping[str, Any], model) -> Dict[str, Any]:
             "LayerNorm_1": _layer_norm(sd, f"{hf}.output.LayerNorm"),
         }
     return {"params": params}
+
+
+def export_hf_bert(variables: Mapping[str, Any], model) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`import_hf_bert`: a BertClassifier variables pytree →
+    a HuggingFace ``BertForSequenceClassification``-shaped state_dict of numpy
+    arrays (wrap with ``torch.from_numpy`` to load into torch).
+
+    The position embeddings carry the folded token-type-0 row (see
+    import_hf_bert), so the export writes them as-is and zero token-type
+    embeddings — logits-equivalent for single-segment inputs. max positions
+    beyond ``model.max_len`` cannot be reconstructed and are exported at
+    ``model.max_len``."""
+    p = variables["params"]
+    H = model.num_heads
+    E = model.embed_dim
+
+    def lin(d: Mapping[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        return {"weight": np.asarray(d["kernel"]).T.copy(),
+                "bias": np.asarray(d["bias"]).copy()}
+
+    def ln(d: Mapping[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        return {"weight": np.asarray(d["scale"]).copy(),
+                "bias": np.asarray(d["bias"]).copy()}
+
+    out: Dict[str, np.ndarray] = {}
+
+    def put(prefix: str, d: Dict[str, np.ndarray]) -> None:
+        for k, v in d.items():
+            out[f"{prefix}.{k}"] = v
+
+    out["bert.embeddings.word_embeddings.weight"] = np.asarray(
+        p["token_embed"]["embedding"]).copy()
+    out["bert.embeddings.position_embeddings.weight"] = np.asarray(
+        p["pos_embed"])[0].copy()
+    out["bert.embeddings.token_type_embeddings.weight"] = np.zeros(
+        (2, E), np.float32)
+    put("bert.embeddings.LayerNorm", ln(p["LayerNorm_0"]))
+
+    for i in range(model.depth):
+        attn = p[f"BertLayer_{i}"]["BertSelfAttention_0"]
+        hf = f"bert.encoder.layer.{i}"
+        for ours, theirs in (("query", "query"), ("key", "key"), ("value", "value")):
+            put(f"{hf}.attention.self.{theirs}", {
+                "weight": np.asarray(attn[ours]["kernel"]).reshape(E, E).T.copy(),
+                "bias": np.asarray(attn[ours]["bias"]).reshape(E).copy(),
+            })
+        put(f"{hf}.attention.output.dense", {
+            "weight": np.asarray(attn["output"]["kernel"]).reshape(E, E).T.copy(),
+            "bias": np.asarray(attn["output"]["bias"]).copy(),
+        })
+        layer = p[f"BertLayer_{i}"]
+        put(f"{hf}.attention.output.LayerNorm", ln(layer["LayerNorm_0"]))
+        put(f"{hf}.intermediate.dense", lin(layer["Dense_0"]))
+        put(f"{hf}.output.dense", lin(layer["Dense_1"]))
+        put(f"{hf}.output.LayerNorm", ln(layer["LayerNorm_1"]))
+
+    put("bert.pooler.dense", lin(p["pooler"]))
+    put("classifier", lin(p["Dense_0"]))
+    return out

@@ -101,3 +101,49 @@ class TestHFBertImport:
                                embed_dim=32, depth=2, num_heads=2, mlp_dim=64)
         with pytest.raises(ValueError):
             import_hf_bert(hf.state_dict(), wrong)
+
+
+class TestHFBertExport:
+    def test_roundtrip_import_export(self):
+        """import -> export reproduces the torch state_dict tensors (modulo the
+        documented token-type fold), and a torch model loaded from the export
+        produces the same logits."""
+        from transformers import BertConfig, BertForSequenceClassification
+
+        from kubeml_tpu.interop import export_hf_bert
+        from kubeml_tpu.models.bert import BertClassifier
+
+        cfg = BertConfig(vocab_size=80, hidden_size=16, num_hidden_layers=2,
+                         num_attention_heads=2, intermediate_size=32,
+                         max_position_embeddings=24, num_labels=2,
+                         hidden_act="gelu")
+        torch.manual_seed(1)
+        hf = BertForSequenceClassification(cfg).eval()
+        ours = BertClassifier(num_classes=2, vocab_size=80, max_len=24,
+                              embed_dim=16, depth=2, num_heads=2, mlp_dim=32)
+        variables = import_hf_bert(hf.state_dict(), ours)
+        exported = export_hf_bert(variables, ours)
+
+        # load the export back into a fresh torch model
+        hf2 = BertForSequenceClassification(cfg).eval()
+        hf2.load_state_dict(
+            {k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in exported.items()},
+            strict=True,
+        )
+        r = np.random.default_rng(1)
+        ids = r.integers(1, 80, size=(3, 12)).astype(np.int64)
+        ids[:, -2:] = 0
+        am = torch.from_numpy((ids != 0).astype(np.int64))
+        with torch.no_grad():
+            a = hf.bert(input_ids=torch.from_numpy(ids), attention_mask=am,
+                        token_type_ids=torch.zeros_like(torch.from_numpy(ids)))
+            b = hf2.bert(input_ids=torch.from_numpy(ids), attention_mask=am,
+                         token_type_ids=torch.zeros_like(torch.from_numpy(ids)))
+        np.testing.assert_allclose(a.last_hidden_state.numpy(),
+                                   b.last_hidden_state.numpy(), atol=1e-5)
+        # per-tensor equality where no fold is involved
+        sd = hf.state_dict()
+        for key in ("bert.encoder.layer.0.attention.self.query.weight",
+                    "bert.encoder.layer.1.output.dense.bias",
+                    "bert.pooler.dense.weight", "classifier.weight"):
+            np.testing.assert_allclose(exported[key], sd[key].numpy(), atol=1e-6)
