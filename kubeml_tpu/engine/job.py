@@ -85,6 +85,8 @@ class TrainJob:
         self.exit_error: Optional[str] = None
         self._stacked_vars = None
         self._final_variables = None
+        # in-flight async checkpoint write (at most one; see _save_checkpoint)
+        self._ckpt_thread: Optional[threading.Thread] = None
 
     # --- public control (reference: train/api.go /stop) ---
 
@@ -226,6 +228,7 @@ class TrainJob:
                 self.history.validation_loss.append(float(val_loss))
                 self.history.accuracy.append(float(val_acc * 100.0))
 
+            self._join_checkpoint()  # epoch writes land before the final export
             self._final_variables = self.trainer.reference_variables(self._stacked_vars)
             # final model export (the reference deletes all weights at job end,
             # util.go:211-244 — here a finished job stays inferable/exportable).
@@ -251,6 +254,7 @@ class TrainJob:
             # persist the history unconditionally, like the deferred save+finish
             # (job.go:161-170) — a failed job records its error so pollers can
             # see the outcome; tensor GC is implicit (device buffers die with us)
+            self._join_checkpoint()  # no orphan writer past job end
             if self.exit_error is not None and isinstance(self.history.task, dict):
                 self.history.task["error"] = self.exit_error
             self.history_store.save(self.history)
@@ -407,19 +411,39 @@ class TrainJob:
             "epoch_duration": list(h.epoch_duration),
         }
 
+    def _join_checkpoint(self) -> None:
+        if self._ckpt_thread is not None:
+            self._ckpt_thread.join()
+            self._ckpt_thread = None
+
     def _save_checkpoint(self, epoch: int) -> None:
         try:
             with self.tracer.span("job.checkpoint", job=self.job_id, epoch=epoch):
-                self.checkpoint_store.save(
-                    self.job_id,
-                    self.trainer.reference_variables(self._stacked_vars),
-                    epoch=epoch,
-                    meta={"request": self.request.to_dict(),
-                          "history": self._history_lists()},
+                # the device->host copy is synchronous (it must snapshot THIS
+                # epoch's weights), but the npz write + retention prune run on
+                # a background thread so the next epoch trains meanwhile; at
+                # most one write is in flight (epoch ordering preserved)
+                self._join_checkpoint()
+                variables = self.trainer.reference_variables(self._stacked_vars)
+                meta = {"request": self.request.to_dict(),
+                        "history": self._history_lists()}
+
+                def write():
+                    try:
+                        self.checkpoint_store.save(
+                            self.job_id, variables, epoch=epoch, meta=meta
+                        )
+                        self.checkpoint_store.prune_epochs(
+                            self.job_id, self.request.options.checkpoint_keep
+                        )
+                    except Exception:
+                        log.exception("%s: async checkpoint write failed (non-fatal)",
+                                      self.job_id)
+
+                self._ckpt_thread = threading.Thread(
+                    target=write, name=f"ckpt-{self.job_id}", daemon=True
                 )
-                self.checkpoint_store.prune_epochs(
-                    self.job_id, self.request.options.checkpoint_keep
-                )
+                self._ckpt_thread.start()
         except Exception:
             log.exception("%s: checkpoint save failed (non-fatal)", self.job_id)
 
