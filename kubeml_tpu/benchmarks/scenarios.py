@@ -430,8 +430,11 @@ def run_all(config: Optional[Config] = None, quick: bool = True,
         if unknown:
             raise ValueError(f"unknown scenario name(s) {unknown}; known: {known}")
     results = []
-    # quick mode caps elastic growth: every new parallelism is a recompile
-    with ExperimentDriver(cfg, max_parallelism=4 if quick else None) as driver:
+    # cap elastic growth in both modes: every new (model, parallelism) pair is
+    # a recompile, and unbounded growth during the concurrent elastic scenario
+    # turns the run into compile churn (measured: full-mode elastic-multijob
+    # timed out on one chip behind the remote-compile tunnel without a cap)
+    with ExperimentDriver(cfg, max_parallelism=4 if quick else 8) as driver:
         for sc in scenarios():
             if names and sc.name not in names:
                 continue
