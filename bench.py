@@ -99,10 +99,16 @@ def main():
                 "metric": f"{fs.name}-kavg-train-throughput",
                 "value": round(device_sps, 1),
                 "unit": "samples/sec",
-                "vs_baseline": round(device_sps / fs.baseline_sps, 3),
+                # apples-to-apples: fs.baseline_sps is an END-TO-END single-GPU
+                # figure, so the headline ratio uses the end-to-end number;
+                # the device-bound ratio is reported separately
+                "vs_baseline": round(e2e_sps / fs.baseline_sps, 3),
+                "vs_baseline_device": round(device_sps / fs.baseline_sps, 3),
                 "end_to_end": round(e2e_sps, 1),
                 "note": "value = device throughput (slabs in HBM); end_to_end "
-                        "includes staging over this dev box's ~17MB/s tunnel",
+                        "includes staging over this dev box's ~17MB/s tunnel; "
+                        "vs_baseline compares end_to_end against the reference "
+                        "single-GPU end-to-end class",
             }
         )
     )

@@ -48,14 +48,16 @@ def _style(ax, title: str, xlabel: str, ylabel: str) -> None:
 
 
 def _series_colors(keys: Sequence) -> Dict:
-    """Fixed-order hue assignment: i-th distinct (sorted) key -> slot i."""
+    """Fixed-order hue assignment: i-th distinct (sorted) key -> slot i.
+
+    Keys beyond the palette render in the muted neutral instead of cycling
+    hues (or crashing): a merged sweep with extra K values still renders, the
+    first 8 series keep their stable hues, and the tail reads as background."""
     ordered = sorted(set(keys), key=lambda k: (isinstance(k, str), k))
-    if len(ordered) > len(CATEGORICAL):
-        raise ValueError(
-            f"{len(ordered)} series exceed the categorical palette; "
-            "facet or fold the tail into 'other' instead of cycling hues"
-        )
-    return {k: CATEGORICAL[i] for i, k in enumerate(ordered)}
+    return {
+        k: CATEGORICAL[i] if i < len(CATEGORICAL) else MUTED
+        for i, k in enumerate(ordered)
+    }
 
 
 def _label_k(k: int) -> str:

@@ -224,12 +224,20 @@ def main(argv=None) -> int:
         with open(args.csv, "w") as f:
             f.write(to_csv(results))
     if args.figures:
-        import matplotlib
+        # a rendering failure must not turn the whole sweep non-zero after the
+        # results were already written
+        try:
+            import matplotlib
 
-        matplotlib.use("Agg")
-        from .figures import render_all
+            matplotlib.use("Agg")
+            from .figures import render_all
 
-        render_all(payload, args.figures)
+            render_all(payload, args.figures)
+        except Exception as e:
+            import sys
+
+            print(f"figure rendering failed (results already saved): {e}",
+                  file=sys.stderr)
     return 1 if any(r.status != "ok" for r in results) else 0
 
 

@@ -46,7 +46,14 @@ def _rng(rng: Optional[np.random.Generator]) -> np.random.Generator:
 
 
 def normalize(x: np.ndarray, mean: Sequence[float], std: Sequence[float]) -> np.ndarray:
-    """Per-channel ``(x - mean) / std`` over the trailing channel axis."""
+    """Per-channel ``(x - mean) / std`` over the trailing channel axis.
+
+    uint8 inputs are first rescaled to [0, 1] (torchvision ``ToTensor``
+    semantics — which rescales only uint8) so the published CIFAR/MNIST
+    statistics apply directly to the uint8 slabs datasets store at rest;
+    wider integer types pass through unscaled like floats."""
+    if x.dtype == np.uint8:
+        x = x.astype(np.float32) / 255.0
     mean = np.asarray(mean, x.dtype if np.issubdtype(x.dtype, np.floating) else np.float32)
     std = np.asarray(std, mean.dtype)
     return (x.astype(mean.dtype) - mean) / std

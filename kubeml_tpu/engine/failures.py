@@ -117,7 +117,6 @@ class WorkerHealth:
 # 10x with backoff (reference: ml/pkg/ps/api.go:192-207); anything else
 # propagates immediately.
 TRANSIENT_ERROR_MARKERS = (
-    "INTERNAL:",
     "UNAVAILABLE:",
     "DEADLINE_EXCEEDED",
     "remote_compile",
@@ -126,8 +125,24 @@ TRANSIENT_ERROR_MARKERS = (
     "preempted",
 )
 
+# "INTERNAL:" alone also prefixes genuine XLA program/compiler bugs, which must
+# NOT be retried — it only counts as transient alongside a second marker that
+# ties it to the RPC/transport layer (compared casefolded).
+_INTERNAL_CORROBORATION = (
+    "rpc",
+    "connection",
+    "socket",
+    "stream terminated",
+    "transport",
+)
+
 
 def is_transient_accelerator_error(exc: BaseException) -> bool:
     """True when the exception text matches a known transient fault marker."""
     msg = f"{type(exc).__name__}: {exc}"
-    return any(marker in msg for marker in TRANSIENT_ERROR_MARKERS)
+    if any(marker in msg for marker in TRANSIENT_ERROR_MARKERS):
+        return True
+    if "INTERNAL:" in msg:
+        low = msg.lower()
+        return any(c in low for c in _INTERNAL_CORROBORATION)
+    return False

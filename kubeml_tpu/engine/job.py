@@ -317,6 +317,8 @@ class TrainJob:
             with self.tracer.span("job.round", job=self.job_id, epoch=epoch,
                                   round=rb.round_index):
                 loss = self._run_round(rb, rng, worker_mask, epoch, staged=rb_staged)
+            if loss is None:  # stop requested during retry backoff
+                break
             losses.append(loss)
         if not losses:
             if self.stop_event.is_set():
@@ -333,8 +335,26 @@ class TrainJob:
             log.warning("%s: %d round(s) skipped this epoch (no effective "
                         "participants)", self.job_id, skipped)
         # one blocking host read per epoch, not per round (keeps rounds async);
-        # a NaN here is real divergence and stays visible in the history
-        return float(np.mean([float(l) for l in losses]))
+        # a NaN here is real divergence and stays visible in the history.
+        # This fetch is also where ASYNC device-side faults surface (JAX
+        # dispatch is lazy): by now the round retry can no longer help — the
+        # weights were reassigned to the poisoned outputs — so translate the
+        # fault into an actionable error instead of a bare RPC traceback.
+        try:
+            return float(np.mean([float(l) for l in losses]))
+        except KubeMLError:
+            raise
+        except Exception as e:
+            from .failures import is_transient_accelerator_error
+
+            if is_transient_accelerator_error(e):
+                raise KubeMLError(
+                    f"job {self.job_id}: transient accelerator fault surfaced at "
+                    f"epoch-end loss fetch (round outputs already consumed; "
+                    f"in-round retry cannot recover async faults) — resubmit "
+                    f"with resume=true to restart from the last checkpoint: {e}"
+                ) from e
+            raise
 
     def _run_round(self, rb, rng, worker_mask, epoch: int, staged=None):
         """One staged sync round, retried on transient accelerator faults.
@@ -344,7 +364,16 @@ class TrainJob:
         tunnel's remote-compile RPC (and real fleets' preemptions) can drop
         mid-round; retrying re-stages and re-runs the round — safe because a
         failed round never published averaged weights. Semantic errors
-        (KubeMLError/MergeError) propagate immediately."""
+        (KubeMLError/MergeError) propagate immediately.
+
+        Coverage boundary: JAX dispatch is async, so this retry covers faults
+        that raise *synchronously* (compile-RPC drops, staging failures).
+        A device-side fault in an already-dispatched round surfaces later, at
+        the epoch-end loss fetch, after the variables were reassigned to the
+        poisoned outputs — unrecoverable in-round by design (the buffer is
+        donated); that path is translated into a resume-from-checkpoint error
+        in ``_train_epoch``. The ``alive`` check below guards the related
+        donation hazard within this round."""
         from .failures import is_transient_accelerator_error
 
         req = self.request
@@ -389,7 +418,11 @@ class TrainJob:
                     "retrying: %s", self.job_id, rb.round_index, attempt + 1,
                     attempts, e,
                 )
-                time.sleep(1.0 + attempt)
+                # interruptible backoff: a stop request mustn't wait out the
+                # sleep — and must end as a graceful stop (None), not as a
+                # job failure carrying the transient error
+                if self.stop_event.wait(1.0 + attempt):
+                    return None
 
     def _validate(self, dataset: KubeDataset, handle):
         dataset.set_mode(False)

@@ -52,8 +52,13 @@ def test_series_colors_fixed_order_and_cap():
     # sorted distinct keys -> fixed slots: -1, 1, 4
     assert list(colors) == [-1, 1, 4]
     assert len(set(colors.values())) == 3
-    with pytest.raises(ValueError):
-        _series_colors(list(range(20)))
+    # overflow keys fold into the muted neutral instead of raising/cycling
+    from kubeml_tpu.benchmarks.figures import CATEGORICAL, MUTED
+
+    many = _series_colors(list(range(20)))
+    assert len(many) == 20
+    assert all(many[k] == CATEGORICAL[k] for k in range(len(CATEGORICAL)))
+    assert all(many[k] == MUTED for k in range(len(CATEGORICAL), 20))
 
 
 def test_main_cli(tmp_path, points):

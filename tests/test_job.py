@@ -182,6 +182,14 @@ def test_transient_accelerator_error_retried(mnist_store, tmp_config):
         RuntimeError("INTERNAL: http://x/remote_compile: read body: "
                      "response body closed before all bytes were read"))
     assert not is_transient_accelerator_error(ValueError("bad shapes"))
+    # bare INTERNAL is how genuine XLA program/compiler bugs present — NOT
+    # transient unless corroborated by an RPC/transport-layer marker
+    assert not is_transient_accelerator_error(
+        RuntimeError("INTERNAL: Mosaic failed to lower module"))
+    assert is_transient_accelerator_error(
+        RuntimeError("INTERNAL: RPC stream terminated unexpectedly"))
+    assert is_transient_accelerator_error(
+        RuntimeError("INTERNAL: transport closed: CONNECTION aborted"))
 
     job = TrainJob(
         "retryjob", _request(epochs=1, options=dict(default_parallelism=1, k=2,

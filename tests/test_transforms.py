@@ -19,10 +19,16 @@ def test_normalize_roundtrip(imgs):
 
 
 def test_normalize_casts_integer_input():
+    # integer slabs rescale to [0,1] first (torchvision ToTensor semantics),
+    # so the published channel statistics apply to uint8 data at rest
     x = np.arange(8, dtype=np.uint8).reshape(1, 2, 2, 2)
     out = T.normalize(x, (0.0, 0.0), (1.0, 1.0))
     assert np.issubdtype(out.dtype, np.floating)
-    np.testing.assert_allclose(out.reshape(-1), np.arange(8))
+    np.testing.assert_allclose(out.reshape(-1), np.arange(8) / 255.0)
+    # floats pass through unscaled
+    xf = np.arange(8, dtype=np.float32).reshape(1, 2, 2, 2)
+    np.testing.assert_allclose(
+        T.normalize(xf, (0.0, 0.0), (1.0, 1.0)).reshape(-1), np.arange(8))
 
 
 def test_random_crop_matches_per_item_reference(imgs):
