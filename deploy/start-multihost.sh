@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Boot a kubeml-tpu multi-host deployment over SSH — the counterpart of the
+# reference's one-command cluster bootstrap (ml/hack/cluster_config.sh).
+#
+# Usage:
+#   deploy/start-multihost.sh host0 host1 [host2 ...]
+#
+# host0 becomes the leader (control plane + training); the rest follow. Every
+# host needs the repo at $KUBEML_REPO (default: this repo's path) and a shared
+# or replicated $KUBEML_DATA_ROOT. On Cloud TPU pods you can skip this script
+# entirely: `gcloud compute tpus tpu-vm ssh --worker=all --command=...` with a
+# plain `kubeml start` auto-detects the coordinator.
+set -euo pipefail
+
+if [ "$#" -lt 1 ]; then
+  echo "usage: $0 host0 [host1 ...]" >&2
+  exit 1
+fi
+
+HOSTS=("$@")
+N=${#HOSTS[@]}
+LEADER=${HOSTS[0]}
+COORD_PORT=${KUBEML_COORD_PORT:-12355}
+REPO=${KUBEML_REPO:-$(cd "$(dirname "$0")/.." && pwd)}
+DATA_ROOT=${KUBEML_DATA_ROOT:-/var/lib/kubeml}
+
+for i in "${!HOSTS[@]}"; do
+  host=${HOSTS[$i]}
+  echo "starting process $i/$N on $host"
+  ssh "$host" "cd $REPO && \
+    KUBEML_COORDINATOR=$LEADER:$COORD_PORT \
+    KUBEML_NUM_PROCESSES=$N \
+    KUBEML_PROCESS_ID=$i \
+    KUBEML_DATA_ROOT=$DATA_ROOT \
+    nohup python -m kubeml_tpu.cli start > /tmp/kubeml-$i.log 2>&1 &" &
+done
+wait
+echo "cluster starting; controller will listen on $LEADER (port \${KUBEML_CONTROLLER_PORT:-9090})"
+echo "logs: /tmp/kubeml-<i>.log on each host"

@@ -61,6 +61,12 @@ class Config:
         )
     )
     use_native_loader: bool = field(default_factory=lambda: _env_bool("KUBEML_NATIVE_LOADER", True))
+    # multi-host: seconds the PS waits for every follower's job-start ack
+    # before aborting the job (a follower missing the function/dataset must
+    # fail the start, not hang the first collective)
+    dist_ack_timeout: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_DIST_ACK_TIMEOUT", "120"))
+    )
     # persistent XLA compilation cache: elastic re-meshes recompile per worker
     # count and standalone job runners are fresh processes — both hit this disk
     # cache instead of recompiling (SURVEY §7 "elastic parallelism vs XLA").

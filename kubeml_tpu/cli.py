@@ -237,6 +237,25 @@ def cmd_start(args) -> int:
     import signal
     import threading
 
+    # multi-host boot (reference: one Helm release spanning nodes,
+    # ml/charts/kubeml/templates/deployment.yaml): run `kubeml start` on every
+    # TPU-VM host with KUBEML_COORDINATOR / KUBEML_NUM_PROCESSES /
+    # KUBEML_PROCESS_ID set (auto-detected on Cloud TPU pods). Process 0 boots
+    # the control plane; the others follow its job announcements and join
+    # every training collective.
+    from .parallel.distributed import init_distributed
+
+    distributed = init_distributed()
+    if distributed:
+        import jax
+
+        if jax.process_index() > 0:
+            from .engine.follower import run_follower
+
+            print(f"kubeml-tpu follower {jax.process_index()}/{jax.process_count()}")
+            run_follower(config=cfg)
+            return 0
+
     from .cluster import LocalCluster
 
     stop = threading.Event()

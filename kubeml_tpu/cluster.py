@@ -37,6 +37,22 @@ class LocalCluster:
         self.cfg.ensure_dirs()
         self.serve_http = serve_http
 
+        # multi-host: the control plane lives on process 0 (the leader); the
+        # PS announces jobs to follower processes over the host channel
+        # (engine.follower) so every host joins the training collectives
+        self.dist = None
+        import jax
+
+        if jax.process_count() > 1:
+            from .parallel.distributed import get_dist_context
+
+            self.dist = get_dist_context()
+            if not self.dist.is_leader:
+                raise RuntimeError(
+                    "LocalCluster must run on process 0; follower processes "
+                    "run kubeml_tpu.engine.follower.run_follower"
+                )
+
         self.store = ShardStore(config=self.cfg)
         self.history_store = HistoryStore(config=self.cfg)
         self.registry = FunctionRegistry(config=self.cfg)
@@ -46,6 +62,7 @@ class LocalCluster:
             history_store=self.history_store,
             config=self.cfg,
             devices=devices,
+            dist=self.dist,
         )
         self.scheduler = Scheduler(self.ps, config=self.cfg)
         self.ps.bind_scheduler(self.scheduler)
@@ -74,6 +91,12 @@ class LocalCluster:
 
     def stop(self) -> None:
         self.ps.shutdown_standalone_jobs()
+        # stop threaded jobs BEFORE the shutdown announcement: a running
+        # multi-host job holds the dist lock for its whole duration, and its
+        # followers only learn about the stop through the job's own per-round
+        # broadcast — announcing first would wait out every remaining epoch
+        self.ps.stop_running_jobs()
+        self.ps.announce_shutdown()  # release follower processes (multi-host)
         self.scheduler.stop()
         if self.serve_http:
             for svc in (self.controller, self.storage_service, self.scheduler_api, self.ps_api):

@@ -30,12 +30,17 @@ from .sharding import RoundPlan
 
 @dataclass
 class RoundBatch:
-    """One sync round of data for all workers."""
+    """One sync round of data for all workers this process feeds.
 
-    x: np.ndarray  # [N, steps, B, ...]
-    y: np.ndarray  # [N, steps, B]
-    mask: np.ndarray  # [N, steps, B] float32
+    Single-process the leading axis is all N workers; in multi-host mode it is
+    only this host's contiguous ``worker_rows`` block of the global worker axis
+    (the engine assembles the global array from per-process blocks)."""
+
+    x: np.ndarray  # [rows, steps, B, ...]
+    y: np.ndarray  # [rows, steps, B]
+    mask: np.ndarray  # [rows, steps, B] float32
     round_index: int
+    worker_rows: Tuple[int, int] = (0, 0)  # [start, end) of the global axis
 
 
 def _worker_round_slice(
@@ -61,19 +66,30 @@ def _worker_round_slice(
 
 
 def build_round(
-    handle: DatasetHandle, split: str, plan: RoundPlan, round_index: int, transform=None
+    handle: DatasetHandle,
+    split: str,
+    plan: RoundPlan,
+    round_index: int,
+    transform=None,
+    worker_rows: Optional[Tuple[int, int]] = None,
 ) -> RoundBatch:
-    """Assemble the uniform padded [N, steps, B, ...] tensors for one round.
+    """Assemble the uniform padded [rows, steps, B, ...] tensors for one round.
+
+    ``worker_rows`` restricts assembly to a contiguous block of the global
+    worker axis — a multi-host process materializes (reads, transforms, pads)
+    ONLY the rows its chips will hold, the counterpart of each reference
+    function loading only its own doc range (python/kubeml/kubeml/util.py:46-56).
 
     The gather/pad into the destination slab runs through the native parallel
     packer when built (kubeml_tpu.native.pack_rounds — one multithreaded memcpy
     instead of numpy's concatenate-then-stack double copy); set
     ``KUBEML_NATIVE_LOADER=0`` or leave the toolchain absent for pure numpy."""
-    n, steps, bsz = plan.n_workers, plan.steps_per_round, plan.batch_size
+    ws, we = worker_rows if worker_rows is not None else (0, plan.n_workers)
+    n, steps, bsz = we - ws, plan.steps_per_round, plan.batch_size
     per_round = steps * bsz
     sample_shape = None
     xs, ys, counts = [], [], []
-    for w in range(n):
+    for w in range(ws, we):
         x, y = _worker_round_slice(handle, split, plan, w, round_index)
         if x is None:
             xs.append(None)
@@ -91,7 +107,26 @@ def build_round(
         ys.append(y)
         counts.append(len(x))
     if sample_shape is None:
-        raise ValueError(f"round {round_index}: no worker has data")
+        if worker_rows is None:
+            raise ValueError(f"round {round_index}: no worker has data")
+        # multi-host: this host's block is exhausted while another host still
+        # has data — emit a fully-padded (mask 0, zero-filled) slab so every
+        # process keeps the same lockstep round count; shapes are probed by
+        # pushing one sample through the transform
+        x0 = np.asarray(handle.raw(split, "data")[:1])
+        y0 = np.asarray(handle.raw(split, "labels")[:1])
+        if transform is not None:
+            x0, y0 = transform(x0, y0)
+        X = np.zeros((n, per_round, *x0.shape[1:]), x0.dtype)
+        Y = np.zeros((n, per_round, *y0.shape[1:]), y0.dtype)
+        M = np.zeros((n, per_round), np.float32)
+        return RoundBatch(
+            x=X.reshape(n, steps, bsz, *x0.shape[1:]),
+            y=Y.reshape(n, steps, bsz, *y0.shape[1:]),
+            mask=M.reshape(n, steps, bsz),
+            round_index=round_index,
+            worker_rows=(ws, we),
+        )
     X = np.empty((n, per_round, *sample_shape), x_dtype)
     Y = np.empty((n, per_round, *label_shape), y_dtype)
     use_native = get_config().use_native_loader
@@ -105,6 +140,7 @@ def build_round(
         y=Y.reshape(n, steps, bsz, *label_shape),
         mask=M.reshape(n, steps, bsz),
         round_index=round_index,
+        worker_rows=(ws, we),
     )
 
 
@@ -118,12 +154,15 @@ class RoundLoader:
         plan: RoundPlan,
         transform=None,
         prefetch: int = 2,
+        worker_rows: Optional[Tuple[int, int]] = None,
     ):
         self.handle = handle
         self.split = split
         self.plan = plan
         self.transform = transform
         self.prefetch = max(1, prefetch)
+        # multi-host: materialize only this process's block of the worker axis
+        self.worker_rows = worker_rows
 
     def __len__(self) -> int:
         return self.plan.num_rounds
@@ -149,7 +188,8 @@ class RoundLoader:
                     if stop.is_set():
                         return
                     if not put_or_abort(
-                        build_round(self.handle, self.split, self.plan, r, self.transform)
+                        build_round(self.handle, self.split, self.plan, r,
+                                    self.transform, worker_rows=self.worker_rows)
                     ):
                         return
                 put_or_abort(None)
@@ -176,6 +216,7 @@ def validation_loader(
     batch_size: int,
     transform=None,
     max_steps_per_round: int = 32,
+    worker_rows: Optional[Tuple[int, int]] = None,
 ) -> "RoundLoader":
     """Stream the test split in bounded rounds — validation fans out across
     workers like the reference (ml/pkg/train/job.go:339-362); masked sums are
@@ -191,4 +232,5 @@ def validation_loader(
         num_samples=handle.num_samples("test"),
         max_steps_per_round=max_steps_per_round,
     )
-    return RoundLoader(handle, "test", plan, transform=transform)
+    return RoundLoader(handle, "test", plan, transform=transform,
+                       worker_rows=worker_rows)

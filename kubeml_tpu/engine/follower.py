@@ -1,0 +1,138 @@
+"""Follower process for multi-host training.
+
+The reference scales out by having the PS create a job pod on some node and
+N serverless functions behind the Fission router (reference:
+ml/pkg/ps/job_pod.go:96-217); every node is driven over HTTP. The TPU-native
+equivalent is JAX's multi-controller model: every TPU-VM host runs the SAME
+program, and only process 0 (the leader) additionally runs the control plane
+(controller/scheduler/PS/storage). The other hosts run this follower loop:
+
+* block on the leader's next command (a host-channel broadcast —
+  ``DistContext.broadcast_obj``; a collective, so the leader announces exactly
+  when followers are waiting);
+* on ``train``: construct the same job from the broadcast task and run it —
+  every jitted program the leader's job thread issues is issued here too, in
+  the same order, so the K-AVG sync average crosses hosts as one XLA
+  collective;
+* on ``shutdown``: exit.
+
+Because all processes must issue collectives in an identical order, the leader
+serializes distributed jobs (one at a time — the PS holds a dist lock for the
+job's duration). The reference gets concurrency from separate pods per job;
+here concurrency within a process group would interleave collectives
+nondeterministically. Datasets, deployed functions, and checkpoints must be
+visible on every host (shared filesystem or replicated data root — the same
+assumption the reference makes of Mongo/Redis being reachable from every pod).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+log = logging.getLogger("kubeml.follower")
+
+
+def run_follower(config=None) -> int:
+    """The follower main loop; returns the number of jobs executed."""
+    from ..api.config import get_config
+    from ..api.errors import KubeMLError
+    from ..api.types import TrainTask
+    from ..functions.registry import FunctionRegistry
+    from ..parallel.distributed import get_dist_context
+    from ..storage.checkpoint import CheckpointStore
+    from ..storage.history import HistoryStore
+    from ..storage.store import ShardStore
+    from .job import TrainJob
+
+    cfg = config or get_config()
+    dist = get_dist_context()
+    if dist.is_leader:
+        raise RuntimeError("run_follower must not run on process 0")
+    registry = FunctionRegistry(config=cfg)
+    store = ShardStore(config=cfg)
+    history_store = HistoryStore(config=cfg)
+    ckpt_store = CheckpointStore(config=cfg)
+    jobs = 0
+    log.info("follower %d/%d ready (awaiting leader commands)", dist.rank, dist.size)
+    while True:
+        cmd = dist.broadcast_obj(None)
+        if not isinstance(cmd, dict) or cmd.get("cmd") == "shutdown":
+            log.info("follower %d: shutdown", dist.rank)
+            return jobs
+        if cmd.get("cmd") != "train":
+            log.warning("follower %d: unknown command %r", dist.rank, cmd)
+            continue
+        task = TrainTask.from_dict(cmd["task"])
+        request = task.parameters
+        # start handshake: construct the job, ack the leader, and only enter
+        # the collectives after the leader's 'go' — a construction failure
+        # here (function/dataset not replicated to this host) aborts the job
+        # cleanly on the leader instead of hanging its first jitted program
+        job = None
+        ack = "ok"
+        try:
+            model = registry.load(request.function_name)
+            model._set_params(lr=request.lr, batch_size=request.batch_size,
+                              epoch=0, k=request.options.k, task="train")
+            request.options.default_parallelism = (
+                task.state.parallelism or request.options.default_parallelism
+            )
+            job = TrainJob(
+                task.job_id, request, model,
+                store=store, history_store=history_store,
+                checkpoint_store=ckpt_store,
+                dist=dist,
+            )
+        except Exception as e:
+            ack = f"err: {e}"
+            log.error("follower %d: job %s start failed: %s",
+                      dist.rank, task.job_id, e)
+        dist.put(f"kubeml/ack/{cmd['run']}/{dist.rank}", ack)
+        go = bool(dist.broadcast_obj(None).get("go"))
+        if not go or job is None:
+            log.warning("follower %d: job %s aborted before start",
+                        dist.rank, task.job_id)
+            continue
+        # Failure semantics: KubeMLError is DETERMINISTIC (every process's
+        # copy of the job raises it at the same point — the leader records it
+        # through the control plane), so the follower logs it and returns to
+        # the command loop in sync. Anything else (a one-sided runtime fault
+        # on this host) PROPAGATES and kills this process, so the
+        # coordination service aborts the leader's collectives with an error
+        # instead of hanging them forever; recovery = restart + resume.
+        try:
+            job.train()
+            log.info("follower %d: job %s done", dist.rank, task.job_id)
+        except KubeMLError as e:
+            from .failures import is_transient_accelerator_error
+
+            cause = e.__cause__
+            if cause is not None and is_transient_accelerator_error(cause):
+                # accelerator/RPC faults are one-sided — the other processes
+                # did NOT raise this and are blocked in a collective
+                raise
+            log.error("follower %d: job %s failed: %s", dist.rank, task.job_id, e)
+        jobs += 1
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    from ..parallel.distributed import init_distributed
+
+    parser = argparse.ArgumentParser(description="kubeml-tpu follower process")
+    parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    if not init_distributed():
+        raise SystemExit("follower requires a multi-process jax.distributed "
+                         "setup (KUBEML_COORDINATOR / KUBEML_NUM_PROCESSES / "
+                         "KUBEML_PROCESS_ID)")
+    run_follower()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -57,6 +57,7 @@ class TrainJob:
         seed: int = 0,
         chaos: Optional[FailureInjector] = None,
         health_threshold: int = 3,
+        dist=None,
     ):
         self.job_id = job_id
         self.request = request
@@ -68,10 +69,33 @@ class TrainJob:
         self.on_metrics = on_metrics
         self.seed = seed
 
+        # multi-controller context: every process runs this same job in
+        # lockstep; control decisions (stop, elastic parallelism) are made on
+        # the leader and broadcast so the collective programs never diverge
+        # (parallel.distributed.DistContext; SURVEY §5 distributed backend)
+        if dist is None and jax.process_count() > 1:
+            from ..parallel.distributed import get_dist_context
+
+            dist = get_dist_context()
+        self.dist = dist
+        self._leader = dist is None or dist.is_leader
+        if dist is not None and dist.size > 1:
+            if chaos is not None or request.options.chaos_prob > 0.0:
+                # chaos masks would have to be bit-identical on every process;
+                # keep fault injection a single-process testing tool
+                raise ValueError("fault injection is not supported in "
+                                 "multi-host mode")
+
         self.parallelism = request.options.default_parallelism
+        if dist is not None and dist.size > 1:
+            # the worker axis must split evenly across host processes
+            self.parallelism = max(
+                dist.size, (self.parallelism // dist.size) * dist.size
+            )
         self.trainer = KAvgTrainer(
             model, precision=request.options.precision, devices=devices,
             donate=request.options.donate, mesh_shape=request.options.mesh_shape,
+            dist=dist,
         )
         # fault injection + health-based re-meshing (SURVEY §5/§7)
         if chaos is None and request.options.chaos_prob > 0.0:
@@ -134,7 +158,7 @@ class TrainJob:
             acc_pct = None
             epochs_run = 0
             for epoch in range(start_epoch, req.epochs):
-                if self.stop_event.is_set():
+                if self._sync_stop():
                     log.info("%s: stop requested, exiting at epoch %d", self.job_id, epoch)
                     break
                 t0 = time.time()
@@ -164,11 +188,25 @@ class TrainJob:
                         self.health.reset()  # indices renumber after the re-mesh
 
                 # elastic re-evaluation (job.go:196-215): ask the scheduler with
-                # this epoch's elapsed time unless parallelism is static
-                if not opts.static_parallelism and self.on_epoch_end is not None:
-                    new_p = self.on_epoch_end(
-                        JobState(parallelism=self.parallelism, elapsed_time=elapsed)
-                    )
+                # this epoch's elapsed time unless parallelism is static. The
+                # leader asks (its elapsed time stands for the job) and the
+                # answer is broadcast so every process re-meshes identically.
+                if not opts.static_parallelism and (
+                    self.on_epoch_end is not None or self.dist is not None
+                ):
+                    new_p = None
+                    if self._leader and self.on_epoch_end is not None:
+                        new_p = self.on_epoch_end(
+                            JobState(parallelism=self.parallelism, elapsed_time=elapsed)
+                        )
+                    if self.dist is not None:
+                        _, p = self.dist.broadcast_flags(parallelism=new_p or 0)
+                        new_p = p or None
+                        if new_p and self.dist.size > 1:
+                            new_p = max(
+                                self.dist.size,
+                                (new_p // self.dist.size) * self.dist.size,
+                            )
                     if new_p and new_p != self.parallelism:
                         log.info(
                             "%s: parallelism %d -> %d", self.job_id, self.parallelism, new_p
@@ -196,7 +234,9 @@ class TrainJob:
                     validation_loss=val_loss,
                     accuracy=acc_pct,
                 )
-                self._push_metrics(train_loss, val_loss, acc_pct, elapsed, used_parallelism)
+                if self._leader:
+                    self._push_metrics(train_loss, val_loss, acc_pct, elapsed,
+                                       used_parallelism)
                 if opts.checkpoint_every > 0 and (epoch + 1) % opts.checkpoint_every == 0:
                     self._save_checkpoint(epoch)
                 log.info(
@@ -229,12 +269,16 @@ class TrainJob:
                 self.history.accuracy.append(float(val_acc * 100.0))
 
             self._join_checkpoint()  # epoch writes land before the final export
-            self._final_variables = self.trainer.reference_variables(self._stacked_vars)
+            # device->host snapshot of the final model: a COLLECTIVE in dist
+            # mode (every process must join the extraction — even the leader
+            # eagerly indexing shard 0 of a global array would hang waiting
+            # for the others); only the leader persists it below
+            self._final_variables = self._snapshot_reference()
             # final model export (the reference deletes all weights at job end,
             # util.go:211-244 — here a finished job stays inferable/exportable).
             # A no-op resume skips the rewrite unless no final export exists yet
             # (crash after the last epoch checkpoint but before the final save).
-            if opts.save_model and (
+            if self._leader and opts.save_model and (
                 epochs_run > 0 or FINAL_TAG not in self.checkpoint_store.tags(self.job_id)
             ):
                 self.checkpoint_store.save(
@@ -257,10 +301,22 @@ class TrainJob:
             self._join_checkpoint()  # no orphan writer past job end
             if self.exit_error is not None and isinstance(self.history.task, dict):
                 self.history.task["error"] = self.exit_error
-            self.history_store.save(self.history)
+            if self._leader:
+                self.history_store.save(self.history)
         return self.history
 
     # --- internals ---
+
+    def _sync_stop(self) -> bool:
+        """Stop decision every process agrees on: the leader's stop_event is
+        broadcast (COLLECTIVE in dist mode) so no process leaves the lockstep
+        round/epoch loop while others still issue collectives."""
+        stop = self.stop_event.is_set()
+        if self.dist is not None:
+            stop, _ = self.dist.broadcast_flags(stop=stop)
+            if stop:
+                self.stop_event.set()
+        return stop
 
     def _train_epoch(self, epoch: int, handle, dataset: KubeDataset) -> float:
         req = self.request
@@ -273,7 +329,8 @@ class TrainJob:
             subset_size=handle.subset_size,
             num_samples=handle.num_samples("train"),
         )
-        loader = RoundLoader(handle, "train", plan, transform=dataset.transform)
+        loader = RoundLoader(handle, "train", plan, transform=dataset.transform,
+                             worker_rows=self.trainer.local_rows(self.parallelism))
         rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch + 1)
         losses = []
         skipped = 0
@@ -292,7 +349,7 @@ class TrainJob:
             staged = None if current is None else self.trainer.stage_round(
                 current.x, current.y, current.mask, self.parallelism
             )
-            if self.stop_event.is_set():
+            if self._sync_stop():
                 break
             worker_mask = None
             if self.chaos is not None:
@@ -377,7 +434,10 @@ class TrainJob:
         from .failures import is_transient_accelerator_error
 
         req = self.request
-        attempts = 3
+        # no retry in multi-host mode: one process retrying while the others
+        # proceed would deadlock the collective — a fault fails the job and
+        # recovery is resume-from-checkpoint
+        attempts = 1 if (self.dist is not None and self.dist.size > 1) else 3
         for attempt in range(attempts):
             try:
                 # async-stage the slabs (bf16 host cast / quantized uint8 +
@@ -427,7 +487,9 @@ class TrainJob:
     def _validate(self, dataset: KubeDataset, handle):
         dataset.set_mode(False)
         loader = validation_loader(
-            handle, self.parallelism, self.request.batch_size, transform=dataset.transform
+            handle, self.parallelism, self.request.batch_size,
+            transform=dataset.transform,
+            worker_rows=self.trainer.local_rows(self.parallelism),
         )
         with self.tracer.span("job.validate", job=self.job_id):
             acc, loss = self.trainer.evaluate_rounds(self._stacked_vars, loader)
@@ -449,15 +511,27 @@ class TrainJob:
             self._ckpt_thread.join()
             self._ckpt_thread = None
 
+    def _snapshot_reference(self):
+        """Device->host copy of the reference model. COLLECTIVE in dist mode:
+        every process must call it at the same point (the extraction is a
+        computation over a non-fully-addressable array)."""
+        if self.dist is not None and self.dist.size > 1:
+            return self.trainer.replicated_reference(self._stacked_vars, self.parallelism)
+        return self.trainer.reference_variables(self._stacked_vars)
+
     def _save_checkpoint(self, epoch: int) -> None:
         try:
             with self.tracer.span("job.checkpoint", job=self.job_id, epoch=epoch):
                 # the device->host copy is synchronous (it must snapshot THIS
-                # epoch's weights), but the npz write + retention prune run on
-                # a background thread so the next epoch trains meanwhile; at
-                # most one write is in flight (epoch ordering preserved)
+                # epoch's weights — and is a collective all processes join in
+                # dist mode), but the npz write + retention prune run on a
+                # background thread so the next epoch trains meanwhile; at
+                # most one write is in flight (epoch ordering preserved).
+                # Only the leader persists the snapshot.
                 self._join_checkpoint()
-                variables = self.trainer.reference_variables(self._stacked_vars)
+                variables = self._snapshot_reference()
+                if not self._leader:
+                    return
                 meta = {"request": self.request.to_dict(),
                         "history": self._history_lists()}
 
@@ -483,13 +557,33 @@ class TrainJob:
     def _restore_latest(self) -> int:
         """Restore the newest checkpoint (selection shared with the SPMD
         engine, engine/resume.py). Returns the epoch to resume from (0 =
-        nothing to restore)."""
+        nothing to restore).
+
+        Multi-host: the LEADER selects the checkpoint and broadcasts the
+        choice, then every process loads that exact tag from its own store
+        (checkpoints are written on the leader, so multi-host resume requires
+        the checkpoint store on a shared filesystem). A follower selecting
+        independently could pick a different epoch — or nothing — and diverge
+        the collective programs; a follower missing the chosen file fails
+        loudly here instead."""
         from .resume import extend_history, select_resume_checkpoint
 
-        best = select_resume_checkpoint(self.checkpoint_store, self.job_id)
-        if best is None:
-            return 0
-        start_epoch, ck = best
+        if self.dist is not None and self.dist.size > 1:
+            sel = None
+            if self._leader:
+                best = select_resume_checkpoint(self.checkpoint_store, self.job_id)
+                if best is not None:
+                    sel = {"epoch": best[0], "tag": best[1].tag}
+            sel = self.dist.broadcast_obj(sel)
+            if sel is None:
+                return 0
+            ck = self.checkpoint_store.restore(self.job_id, tag=sel["tag"])
+            start_epoch = int(sel["epoch"])
+        else:
+            best = select_resume_checkpoint(self.checkpoint_store, self.job_id)
+            if best is None:
+                return 0
+            start_epoch, ck = best
         self._stacked_vars = self.trainer.place_reference(ck.variables, self.parallelism)
         extend_history(self.history, ck)
         log.info("%s: resumed from checkpoint %s (epoch %d)", self.job_id, ck.tag, start_epoch)
@@ -523,4 +617,12 @@ class TrainJob:
     def infer(self, x: np.ndarray):
         if self._stacked_vars is None:
             raise KubeMLError(f"job {self.job_id} has no model yet", 400)
+        if self.dist is not None and self.dist.size > 1:
+            # serving mid-training would need a collective the follower
+            # processes are not at (they are inside the training loop); the
+            # finished model serves from the leader's final checkpoint instead
+            raise KubeMLError(
+                f"job {self.job_id} is training multi-host; inference is "
+                f"served from its checkpoint after it finishes", 409
+            )
         return self.trainer.infer(self._stacked_vars, x)

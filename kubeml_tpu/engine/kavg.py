@@ -45,18 +45,25 @@ from ..runtime.model import KubeModel
 log = logging.getLogger("kubeml.engine")
 
 
-def worker_mesh(n_workers: int, devices: Optional[List[jax.Device]] = None) -> Mesh:
+def worker_mesh(
+    n_workers: int,
+    devices: Optional[List[jax.Device]] = None,
+    n_procs: int = 1,
+) -> Mesh:
     """A 1-D ``worker`` mesh using the largest device count that divides N.
 
     With N <= devices each worker owns a chip and the sync average rides ICI;
     with fewer devices workers pack onto chips (the single-chip case is a plain
     batched program). The scheduler prefers topology-legal N (powers of two) so
-    the divisor search is a fallback for odd N."""
+    the divisor search is a fallback for odd N. Multi-process: the block is
+    process-major with every process contributing equally, so each host feeds
+    a contiguous slice of worker rows and the sync average crosses hosts as
+    one XLA collective (the reference's whole Redis merge cycle,
+    ml/pkg/model/model.go:249-302, with DCN/ICI instead of TCP-to-Redis)."""
+    from ..parallel.distributed import pick_worker_devices
+
     devices = list(devices if devices is not None else jax.devices())
-    d = min(n_workers, len(devices))
-    while d > 1 and n_workers % d != 0:
-        d -= 1
-    return Mesh(np.array(devices[:d]), ("worker",))
+    return Mesh(np.array(pick_worker_devices(n_workers, devices, n_procs)), ("worker",))
 
 
 def _mean_over_workers(tree, weights: jnp.ndarray):
@@ -92,9 +99,22 @@ class KAvgTrainer:
         donate: bool = True,
         mesh_shape: Optional[Dict[str, int]] = None,
         scan_unroll: int = 1,
+        dist=None,
     ):
         self.model = model
         self.precision = precision
+        # multi-controller context (parallel.distributed.DistContext). When set,
+        # the worker mesh spans all processes' devices, each host stages only
+        # its contiguous block of worker rows (jax.make_array_from_process_
+        # local_data), and variable placement happens inside jitted programs
+        # with out_shardings (a host can't device_put onto chips it doesn't
+        # address). A size-1 DistContext exercises the same code path
+        # single-process — that is what the driver's multichip dry-run runs.
+        if dist is None and jax.process_count() > 1:
+            from ..parallel.distributed import get_dist_context
+
+            dist = get_dist_context()
+        self.dist = dist
         # lax.scan unroll factor for the K local steps (1 = rolled, the
         # default). Measured on v5e for the ResNet-18/CIFAR flagship: unroll=2
         # is ~4% SLOWER with 1.6x the compile time, so the knob stays at 1;
@@ -112,14 +132,27 @@ class KAvgTrainer:
         self.donate = donate
         self._train_cache: Dict[Tuple, Any] = {}
         self._eval_cache: Dict[Tuple, Any] = {}
+        self._rep_cache: Dict[int, Any] = {}  # replica-0 replicated extractors
+        self._place_cache: Dict[int, Any] = {}  # reference-broadcast placers
         self._meshes: Dict[int, Mesh] = {}
 
     # --- mesh / placement ---
 
     def mesh_for(self, n_workers: int) -> Mesh:
         if n_workers not in self._meshes:
-            self._meshes[n_workers] = worker_mesh(n_workers, self.devices)
+            n_procs = self.dist.size if self.dist is not None else 1
+            self._meshes[n_workers] = worker_mesh(n_workers, self.devices, n_procs)
         return self._meshes[n_workers]
+
+    def local_rows(self, n_workers: int):
+        """[start, end) block of worker rows this process feeds (the loader
+        materializes only these — reference counterpart: each function loads
+        only its contiguous doc range, python/kubeml/kubeml/util.py:46-56)."""
+        from ..parallel.distributed import local_worker_rows
+
+        if self.dist is None:
+            return 0, n_workers
+        return local_worker_rows(n_workers, self.dist.rank, self.dist.size)
 
     def _shardings(self, n_workers: int):
         mesh = self.mesh_for(n_workers)
@@ -138,7 +171,11 @@ class KAvgTrainer:
         Host-casts f32 samples to bf16 first (native multithreaded pass —
         halves the host->HBM bytes), then ``jax.device_put``s with the worker
         sharding; the transfer overlaps the previous round's compute because
-        nothing here blocks. Returns (x, y, mask) accepted by sync_round."""
+        nothing here blocks. Returns (x, y, mask) accepted by sync_round.
+
+        Distributed: the slabs hold only this process's worker rows
+        (``local_rows``) and are assembled into global arrays — each host DMAs
+        its block onto its own chips, nothing crosses DCN at staging time."""
         sharded, _ = self._shardings(n_workers)
         x = batch_x
         if (
@@ -149,6 +186,13 @@ class KAvgTrainer:
             from ..native import f32_to_bf16
 
             x = f32_to_bf16(x)
+        if self.dist is not None:
+            def globalize(local):
+                local = np.asarray(local)
+                gshape = (n_workers,) + local.shape[1:]
+                return jax.make_array_from_process_local_data(sharded, local, gshape)
+
+            return globalize(x), globalize(batch_y), globalize(mask)
         return (
             jax.device_put(x, sharded),
             jax.device_put(batch_y, sharded),
@@ -160,20 +204,41 @@ class KAvgTrainer:
     def init_variables(self, rng: jax.Array, sample_x: np.ndarray, n_workers: int):
         """Initialize one replica and broadcast it across the worker axis, placed
         sharded over the mesh (the reference's init function publishing reference
-        weights to Redis, network.py:174-189)."""
+        weights to Redis, network.py:174-189).
+
+        Distributed: init runs INSIDE a jitted program with sharded
+        out_shardings — every process traces the same init from the same rng
+        and XLA materializes each shard on its owner, so no host ever needs to
+        address another host's chips."""
+        sharded, _ = self._shardings(n_workers)
+        if self.dist is not None:
+            sample_host = np.asarray(sample_x)
+
+            def init_stacked(r):
+                sample = self.model.preprocess(self._cast_input(jnp.asarray(sample_host)))
+                variables = self.model.init(r, sample)
+                return _broadcast_to_workers(variables, n_workers)
+
+            return jax.jit(init_stacked, out_shardings=sharded)(rng)
         sample = self.model.preprocess(self._cast_input(jnp.asarray(sample_x)))
         variables = self.model.init(rng, sample)
         stacked = _broadcast_to_workers(variables, n_workers)
-        sharded, _ = self._shardings(n_workers)
         return jax.device_put(stacked, sharded)
 
     def resize(self, stacked_vars, old_n: int, new_n: int):
         """Elastic re-mesh between epochs: replicas are identical after a sync, so
-        take replica 0 and re-broadcast onto the new mesh. The reshard is a direct
-        device_put between shardings — device-to-device over ICI, no host bounce
-        of the model."""
+        take replica 0 and re-broadcast onto the new mesh. Single-process the
+        reshard is a direct device_put between shardings — device-to-device over
+        ICI, no host bounce. Distributed, the old and new meshes may span
+        different device sets, which XLA cannot reshard across in one step: the
+        replica is first replicated onto every host (one collective), then
+        re-placed through a jitted broadcast on the new mesh — a host bounce,
+        paid at most once per epoch when elasticity changes N."""
         if old_n == new_n:
             return stacked_vars
+        if self.dist is not None:
+            host_ref = self.replicated_reference(stacked_vars, old_n)
+            return self.place_reference(host_ref, new_n)
         one = jax.tree.map(lambda x: x[0], stacked_vars)
         stacked = _broadcast_to_workers(one, new_n)
         sharded, _ = self._shardings(new_n)
@@ -181,13 +246,49 @@ class KAvgTrainer:
 
     def place_reference(self, variables, n_workers: int):
         """Broadcast one reference replica (e.g. a restored checkpoint) across the
-        worker axis, sharded over the mesh — the inverse of reference_variables."""
-        stacked = _broadcast_to_workers(jax.tree.map(jnp.asarray, variables), n_workers)
+        worker axis, sharded over the mesh — the inverse of reference_variables.
+        All processes must pass identical host values (collective in dist mode)."""
         sharded, _ = self._shardings(n_workers)
+        if self.dist is not None:
+            host_vars = jax.tree.map(np.asarray, variables)
+            fn = self._place_cache.get(n_workers)
+            if fn is None:
+                fn = jax.jit(
+                    lambda v: _broadcast_to_workers(v, n_workers),
+                    out_shardings=sharded,
+                )
+                self._place_cache[n_workers] = fn
+            return fn(host_vars)
+        stacked = _broadcast_to_workers(jax.tree.map(jnp.asarray, variables), n_workers)
         return jax.device_put(stacked, sharded)
 
+    def _replica0_replicated(self, stacked_vars, n_workers: int):
+        """COLLECTIVE in dist mode: replica 0 as a fully-replicated global
+        array (every process addresses a copy)."""
+        fn = self._rep_cache.get(n_workers)
+        if fn is None:
+            _, replicated = self._shardings(n_workers)
+            fn = jax.jit(
+                lambda v: jax.tree.map(lambda x: x[0], v), out_shardings=replicated
+            )
+            self._rep_cache[n_workers] = fn
+        return fn(stacked_vars)
+
+    def replicated_reference(self, stacked_vars, n_workers: int):
+        """COLLECTIVE: replica 0 gathered replicated onto every process, then
+        host-fetched — the cross-host path to the reference model. Followers
+        can't index shard 0 of a global array they don't address, and even the
+        leader indexing it eagerly would HANG: an op on a non-fully-addressable
+        array requires every process to execute it."""
+        rep = self._replica0_replicated(stacked_vars, n_workers)
+        return jax.tree.map(np.asarray, rep)
+
     def reference_variables(self, stacked_vars):
-        """One replica of the (post-sync) variables — the 'reference model'."""
+        """One replica of the (post-sync) variables — the 'reference model'.
+
+        Single-process/addressable arrays only: in distributed mode use the
+        collective ``replicated_reference`` — indexing a multi-process global
+        array is itself a computation all processes must join."""
         return jax.tree.map(lambda x: np.asarray(x[0]), stacked_vars)
 
     # --- the jitted sync round ---
@@ -346,31 +447,58 @@ class KAvgTrainer:
             out_shardings=(replicated, replicated, replicated),
         )
 
-    def _eval_sums(self, variables, batch_x, batch_y, mask):
-        n = batch_x.shape[0]
-        key = (n, batch_x.shape[1:], str(batch_x.dtype),
-               batch_y.shape[1:], str(batch_y.dtype))
+    def _stacked_n(self, stacked_vars) -> int:
+        return int(jax.tree.leaves(stacked_vars)[0].shape[0])
+
+    def _eval_reference(self, stacked_vars):
+        """Replica 0 for evaluation: a cheap lazy slice single-process, a
+        replicated collective extraction in dist mode (followers cannot
+        address shard 0 directly)."""
+        if self.dist is not None:
+            return self._replica0_replicated(stacked_vars, self._stacked_n(stacked_vars))
+        return jax.tree.map(lambda v: v[0], stacked_vars)
+
+    def _stage_eval(self, batch_x, batch_y, mask, n_workers: int):
+        if self.dist is not None:
+            sharded, _ = self._shardings(n_workers)
+
+            def globalize(local):
+                local = np.asarray(local)
+                return jax.make_array_from_process_local_data(
+                    sharded, local, (n_workers,) + local.shape[1:]
+                )
+
+            return globalize(batch_x), globalize(batch_y), globalize(mask)
+        return jnp.asarray(batch_x), jnp.asarray(batch_y), jnp.asarray(mask)
+
+    def _eval_sums(self, variables, batch_x, batch_y, mask, n_workers: Optional[int] = None):
+        # in dist mode batch rows are process-local; the worker count is global
+        n = n_workers if n_workers is not None else batch_x.shape[0]
+        x, y, m = self._stage_eval(batch_x, batch_y, mask, n)
+        key = (n, x.shape[1:], str(x.dtype), y.shape[1:], str(y.dtype))
         fn = self._eval_cache.get(key)
         if fn is None:
             fn = self._build_eval(n)
             self._eval_cache[key] = fn
-        return fn(variables, jnp.asarray(batch_x), jnp.asarray(batch_y), jnp.asarray(mask))
+        return fn(variables, x, y, m)
 
     def evaluate(self, stacked_vars, batch_x, batch_y, mask) -> Tuple[float, float]:
         """Masked (accuracy, loss) over one [N, steps, B, ...] validation slab —
         sample-weighted exactly like the reference's weighted validation average."""
-        variables = jax.tree.map(lambda v: v[0], stacked_vars)
-        c, l, m = self._eval_sums(variables, batch_x, batch_y, mask)
+        variables = self._eval_reference(stacked_vars)
+        n = self._stacked_n(stacked_vars) if self.dist is not None else None
+        c, l, m = self._eval_sums(variables, batch_x, batch_y, mask, n_workers=n)
         denom = max(float(m), 1.0)
         return float(c) / denom, float(l) / denom
 
     def evaluate_rounds(self, stacked_vars, rounds) -> Tuple[float, float]:
         """Streamed evaluation: accumulate masked sums over an iterable of
         RoundBatches (peak memory = one round, not the whole split)."""
-        variables = jax.tree.map(lambda v: v[0], stacked_vars)
+        variables = self._eval_reference(stacked_vars)
+        n = self._stacked_n(stacked_vars) if self.dist is not None else None
         csum = lsum = msum = 0.0
         for rb in rounds:
-            c, l, m = self._eval_sums(variables, rb.x, rb.y, rb.mask)
+            c, l, m = self._eval_sums(variables, rb.x, rb.y, rb.mask, n_workers=n)
             csum += float(c)
             lsum += float(l)
             msum += float(m)
@@ -378,6 +506,8 @@ class KAvgTrainer:
         return csum / denom, lsum / denom
 
     def infer(self, stacked_vars, x: np.ndarray):
+        # NOT collective: serves from shard 0, so in dist mode only the leader
+        # (which addresses device 0) calls it — the PS serving path lives there
         variables = jax.tree.map(lambda v: v[0], stacked_vars)
         return np.asarray(
             self.model.infer(

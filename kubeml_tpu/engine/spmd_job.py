@@ -59,7 +59,10 @@ class SPMDJob:
         on_metrics=None,
         devices=None,
         seed: int = 0,
+        dist=None,  # interface parity; the PS rejects multi-host SPMD jobs
     ):
+        if dist is not None and getattr(dist, "size", 1) > 1:
+            raise ValueError("SPMDJob does not support multi-host execution")
         self.job_id = job_id
         self.request = request
         self.model = model

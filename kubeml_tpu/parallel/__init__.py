@@ -15,7 +15,10 @@ insert collectives; hand-written collectives (shard_map + ppermute) only where
 the schedule matters (ring attention, a2a expert dispatch).
 """
 
-from .distributed import global_mesh, init_distributed, local_batch_slice, num_slices
+from .distributed import (DistContext, get_dist_context, global_mesh,
+                          init_distributed,
+                          local_batch_slice, local_worker_rows, num_slices,
+                          pick_worker_devices, worker_device_count)
 from .mesh import make_mesh, mesh_shape_for
 from .moe import MoEBlock, MoEMlp, MoETiny, MoETransformer
 from .pipeline import PipelinedLM, PipelineTrainer, gpipe
@@ -25,6 +28,11 @@ from .ulysses import ulysses_attention
 __all__ = [
     "global_mesh",
     "init_distributed",
+    "DistContext",
+    "get_dist_context",
+    "local_worker_rows",
+    "pick_worker_devices",
+    "worker_device_count",
     "local_batch_slice",
     "num_slices",
     "MoEBlock",

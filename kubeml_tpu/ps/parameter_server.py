@@ -75,6 +75,7 @@ class ParameterServer:
         metrics: Optional[MetricsRegistry] = None,
         config: Optional[Config] = None,
         devices=None,
+        dist=None,
     ):
         self.cfg = config or get_config()
         self.registry = registry or FunctionRegistry(config=self.cfg)
@@ -88,6 +89,13 @@ class ParameterServer:
         self._serving_cache: Dict[str, tuple] = {}  # (model, vars, ckpt mtime)
         self._ckpt_store = CheckpointStore(config=self.cfg)
         self._lock = threading.RLock()
+        # multi-host: the PS runs on process 0 and announces each job to the
+        # follower processes over the host channel; jobs serialize on
+        # _dist_lock because all processes must issue collectives in one
+        # global order (see engine.follower module docstring)
+        self.dist = dist
+        self._dist_lock = threading.Lock()
+        self._dist_run = 0  # per-announcement nonce (ack keys must be unique)
 
     def bind_scheduler(self, scheduler) -> None:
         self.scheduler = scheduler
@@ -104,10 +112,21 @@ class ParameterServer:
         two concurrent starts of the same job id can't both win; a failed start
         leaves a FAILED history record so clients polling the job don't see it
         silently vanish."""
+        dist = self.dist if (self.dist is not None and self.dist.size > 1) else None
         if self.cfg.standalone_jobs:
+            if dist is not None:
+                raise KubeMLError(
+                    "standalone job runners are a single-host deployment mode; "
+                    "multi-host training runs jobs threaded on every process", 400
+                )
             self._start_standalone(task)
             return
         req = task.parameters
+        if dist is not None and req.options.engine == "spmd":
+            raise KubeMLError(
+                "the SPMD engine does not run multi-host yet; use the K-AVG "
+                "engine (default) for multi-host jobs", 400
+            )
         placeholder = self._reserve_slot(task)
         try:
             model = self.registry.load(req.function_name)
@@ -129,12 +148,14 @@ class ParameterServer:
                 on_epoch_end=lambda state, jid=task.job_id: self._epoch_end(jid, state),
                 on_metrics=self.metrics.update,
                 devices=self.devices,
+                dist=dist,
             )
         except Exception as e:
             self._fail_start(task, e)
             raise
+        runner = self._run_job if dist is None else self._run_job_dist
         thread = threading.Thread(
-            target=self._run_job, args=(task, job), name=f"job-{task.job_id}", daemon=True
+            target=runner, args=(task, job), name=f"job-{task.job_id}", daemon=True
         )
         placeholder.job = job
         placeholder.thread = thread
@@ -360,6 +381,59 @@ class ParameterServer:
                 r.proc.terminate()
             except Exception:
                 pass
+
+    def _run_job_dist(self, task: TrainTask, job: TrainJob) -> None:
+        """Multi-host job thread: serialize on the dist lock (all processes
+        must see one global collective order), announce the task to the
+        follower processes, then run the job — every collective the job issues
+        here is mirrored by the followers (engine.follower.run_follower).
+
+        Start handshake: every follower acks that it constructed the job
+        BEFORE anyone enters the first jitted program. A follower that can't
+        (function or dataset missing on its host) would otherwise leave the
+        leader hanging forever in a collective only some processes joined."""
+        with self._dist_lock:
+            run = self._dist_run
+            self._dist_run += 1
+            self.dist.broadcast_obj(
+                {"cmd": "train", "task": task.to_dict(), "run": run}
+            )
+            errs = []
+            for rank in range(1, self.dist.size):
+                ack = self.dist.get(
+                    f"kubeml/ack/{run}/{rank}", timeout_s=self.cfg.dist_ack_timeout
+                )
+                if ack is None:
+                    errs.append(f"rank {rank}: no job-start ack (timeout)")
+                elif ack != "ok":
+                    errs.append(f"rank {rank}: {ack}")
+            self.dist.broadcast_obj({"go": not errs})
+            if errs:
+                err = "follower(s) could not start the job: " + "; ".join(errs)
+                log.error("job %s aborted before start: %s", task.job_id, err)
+                task.status = JobStateEnum.FAILED
+                self._ensure_failure_history(task.job_id, task.parameters, err)
+                self._finish(task.job_id)
+                return
+            self._run_job(task, job)
+
+    def stop_running_jobs(self) -> None:
+        """Cooperative stop for every threaded job (multi-host shutdown must
+        stop the running job FIRST — announce_shutdown waits on the dist lock
+        its thread holds)."""
+        with self._lock:
+            jobs = [r.job for r in self._jobs.values() if r.job is not None]
+        for job in jobs:
+            try:
+                job.stop()
+            except Exception:
+                log.exception("stopping job failed")
+
+    def announce_shutdown(self) -> None:
+        """Release follower processes at cluster shutdown."""
+        if self.dist is not None and self.dist.size > 1:
+            with self._dist_lock:
+                self.dist.broadcast_obj({"cmd": "shutdown"})
 
     def _run_job(self, task: TrainTask, job: TrainJob) -> None:
         try:
