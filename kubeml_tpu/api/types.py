@@ -98,6 +98,10 @@ class TrainOptions(_JsonMixin):
     # "spmd" = synchronous multi-axis mesh training (transformers/LLMs —
     # mesh_shape picks the axes, e.g. {"dp": 2, "sp": 2, "tp": 2})
     engine: str = "kavg"
+    # SPMD goal metric: stop when eval loss <= goal_loss (0 = off). A
+    # perplexity target P is goal_loss = ln(P). Complements goal_accuracy,
+    # which the SPMD engine applies to next-token top-1 accuracy (%).
+    goal_loss: float = 0.0
     precision: str = "bf16"  # compute dtype for matmul/conv (MXU native)
     mesh_shape: Optional[Dict[str, int]] = None  # explicit mesh override {axis: size}
     donate: bool = True  # donate params buffers into the jitted step

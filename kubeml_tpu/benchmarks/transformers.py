@@ -1,0 +1,120 @@
+"""Transformer training headline benchmark: samples/sec + MFU on one chip.
+
+The round-1 perf story had CNN throughput only; transformers are where MXU
+utilization is actually provable (dense [B*L, D] x [D, 4D] contractions vs the
+small convs of CIFAR models). This measures the ViT-Tiny and BERT-base
+training targets (BASELINE.md targets #3/#4) through the same K-AVG engine
+the platform trains them with, and reports MFU from the compiled executable's
+own FLOP count (kubeml_tpu.benchmarks.mfu — no analytic guessing).
+
+    python -m kubeml_tpu.benchmarks.transformers                # both models
+    python -m kubeml_tpu.benchmarks.transformers --model bert-base --steps 10
+
+Prints one JSON line per model:
+    {"metric": "...-train-throughput", "value": samples/sec, "mfu": ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _bench_kavg(module, name: str, sample, labels, *, k: int, steps_cap: int,
+                reps: int = 3) -> dict:
+    from ..engine.kavg import KAvgTrainer
+    from .harness import make_synthetic_model
+    from .mfu import mfu_from, peak_flops
+
+    model = make_synthetic_model(module, f"bench-{name}")
+    trainer = KAvgTrainer(model, precision="bf16")
+    n = 1  # single-chip headline; multi-chip scaling is the multihost story
+    x = np.broadcast_to(sample, (n, k, *sample.shape)).copy()
+    y = np.broadcast_to(labels, (n, k, *labels.shape)).copy()
+    mask = np.ones(y.shape[:3], np.float32)
+
+    rng = jax.random.PRNGKey(0)
+    variables = trainer.init_variables(rng, sample, n)
+    sx, sy, sm = trainer.stage_round(x, y, mask, n)
+    variables, loss = trainer.sync_round(variables, sx, sy, sm, rng, lr=1e-3)
+    float(loss)  # value-fetch drain (axon: block_until_ready is unreliable)
+
+    batch = sample.shape[0]
+    samples_per_round = n * k * batch
+    best = 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for i in range(steps_cap):
+            variables, loss = trainer.sync_round(
+                variables, sx, sy, sm, jax.random.fold_in(rng, i), lr=1e-3
+            )
+        float(loss)
+        dt = time.perf_counter() - t0
+        best = max(best, steps_cap * samples_per_round / dt)
+
+    # MFU from the compiled program's own cost analysis (1-step count x k —
+    # XLA counts a lax.scan body once regardless of trip count)
+    flops = trainer.round_flops(variables, sx, sy, sm, lr=1e-3)
+    rounds_per_sec = best / samples_per_round
+    mfu = mfu_from(flops, rounds_per_sec)
+    return {
+        "metric": f"{name}-train-throughput",
+        "value": round(best, 1),
+        "unit": "samples/sec",
+        "batch": batch,
+        "k": k,
+        "flops_per_round": flops,
+        "peak_flops": peak_flops(),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "loss": round(float(loss), 4),
+    }
+
+
+def bench_vit(steps: int = 10) -> dict:
+    from ..models.vit import ViTTiny
+
+    r = np.random.default_rng(0)
+    batch = 256
+    sample = r.normal(size=(batch, 32, 32, 3)).astype(np.float32)
+    labels = r.integers(0, 100, size=(batch,)).astype(np.int64)
+    return _bench_kavg(ViTTiny(num_classes=100, dtype=jnp.bfloat16),
+                       "vit-tiny-cifar100", sample, labels, k=8, steps_cap=steps)
+
+
+def bench_bert(steps: int = 5) -> dict:
+    from ..models.bert import BertBase
+
+    r = np.random.default_rng(0)
+    batch, seq = 32, 128
+    sample = r.integers(1, 30000, size=(batch, seq)).astype(np.int32)
+    labels = r.integers(0, 2, size=(batch,)).astype(np.int64)
+    return _bench_kavg(BertBase(num_classes=2, max_len=seq, dtype=jnp.bfloat16),
+                       "bert-base-sst2", sample, labels, k=4, steps_cap=steps)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="transformer training headline benchmark")
+    p.add_argument("--model", choices=["vit-tiny", "bert-base", "all"], default="all")
+    p.add_argument("--steps", type=int, default=None)
+    args = p.parse_args(argv)
+
+    results: List[dict] = []
+    if args.model in ("vit-tiny", "all"):
+        results.append(bench_vit(args.steps or 10))
+        print(json.dumps(results[-1]))
+    if args.model in ("bert-base", "all"):
+        results.append(bench_bert(args.steps or 5))
+        print(json.dumps(results[-1]))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

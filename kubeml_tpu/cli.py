@@ -187,15 +187,26 @@ def cmd_checkpoint(args) -> int:
 
 def cmd_logs(args) -> int:
     cfg = get_config()
-    log_file = cfg.data_root / "logs" / "kubeml.log"
+    # per-job runner log first (standalone mode writes logs/job-<id>.log —
+    # the reference's per-pod `kubectl logs job-<id>`, cmd/log.go:28-66);
+    # fall back to the combined cluster log filtered by job id
+    log_file = None
+    per_job = args.id is not None and (
+        cfg.data_root / "logs" / f"job-{args.id}.log"
+    )
+    if per_job and per_job.exists():
+        log_file = per_job
+    else:
+        log_file = cfg.data_root / "logs" / "kubeml.log"
     if not log_file.exists():
         print(f"no log file at {log_file}", file=sys.stderr)
         return 1
+    filter_id = None if log_file == per_job else args.id
 
     def matching_lines():
         with open(log_file) as f:
             for line in f:
-                if args.id is None or args.id in line:
+                if filter_id is None or filter_id in line:
                     yield line.rstrip()
 
     for line in matching_lines():
@@ -209,7 +220,7 @@ def cmd_logs(args) -> int:
                     if not line:
                         time.sleep(0.5)
                         continue
-                    if args.id is None or args.id in line:
+                    if filter_id is None or filter_id in line:
                         print(line.rstrip())
             except KeyboardInterrupt:
                 pass

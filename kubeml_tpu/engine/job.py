@@ -376,6 +376,11 @@ class TrainJob:
                 loss = self._run_round(rb, rng, worker_mask, epoch, staged=rb_staged)
             if loss is None:  # stop requested during retry backoff
                 break
+            if not losses:
+                # first round dispatched: background-precompile the next
+                # topology-legal scale-up level while this epoch trains, so an
+                # elastic grow pays a compile-cache read instead of a stall
+                self._precompile_next_level(rb, epoch)
             losses.append(loss)
         if not losses:
             if self.stop_event.is_set():
@@ -483,6 +488,50 @@ class TrainJob:
                 # job failure carrying the transient error
                 if self.stop_event.wait(1.0 + attempt):
                     return None
+
+    def _precompile_next_level(self, rb, epoch: int) -> None:
+        """Kick a background AOT compile of sync_round at the next scale-up
+        level (the ladder the scheduler walks, scheduler/policy.py). Round 1's
+        unbounded elastic scenario timed out on synchronous recompiles at
+        every new level; this moves that cost off the training path."""
+        opts = self.request.options
+        if opts.static_parallelism:
+            return
+        try:
+            from ..api.config import get_config
+            from ..scheduler.policy import next_power_down, next_power_up
+
+            cfg = get_config()
+            cap = cfg.max_parallelism or max(8, len(jax.devices()))
+            cap = next_power_down(max(1, cap) + 1)  # scheduler's legal ceiling
+            if self.dist is not None and self.dist.size > 1:
+                cap = (cap // self.dist.size) * self.dist.size
+            next_p = next_power_up(self.parallelism, cap)
+            if next_p == self.parallelism:
+                return
+            # staged dtypes: what stage_round will actually feed at next_p
+            x_dtype = rb.x.dtype
+            if self.request.options.precision == "bf16" and x_dtype == np.float32:
+                import jax.numpy as jnp
+
+                x_dtype = jnp.bfloat16
+            plan_next = plan_epoch(
+                num_docs=self.model.dataset.handle.num_subsets("train"),
+                n_workers=next_p,
+                batch_size=self.request.batch_size,
+                k=opts.k,
+                subset_size=self.model.dataset.handle.subset_size,
+                num_samples=self.model.dataset.handle.num_samples("train"),
+            )
+            self.trainer.precompile_async(
+                self._stacked_vars, next_p, plan_next.steps_per_round,
+                (plan_next.batch_size,) + tuple(rb.x.shape[3:]), x_dtype,
+                (plan_next.batch_size,) + tuple(rb.y.shape[3:]), rb.y.dtype,
+                lr=self.request.lr, epoch=epoch,
+            )
+        except Exception:
+            log.debug("next-level precompile setup failed (non-fatal)",
+                      exc_info=True)
 
     def _validate(self, dataset: KubeDataset, handle):
         dataset.set_mode(False)

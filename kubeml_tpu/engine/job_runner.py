@@ -253,8 +253,23 @@ def main(argv=None) -> int:
     _apply_platform_env()
     from ..api.config import get_config
 
+    cfg = get_config()
+    # per-job log file (the reference streams per-job POD logs via
+    # `kubectl logs job-<id>`, cmd/log.go:28-66; here the runner process IS
+    # the pod, so it writes logs/job-<id>.log and `kubeml logs --id` reads it)
+    try:
+        log_dir = cfg.data_root / "logs"
+        log_dir.mkdir(parents=True, exist_ok=True)
+        handler = logging.FileHandler(log_dir / f"job-{args.job_id}.log")
+        handler.setFormatter(logging.Formatter(
+            f"%(asctime)s job-{args.job_id} %(name)s %(levelname)s %(message)s"
+        ))
+        logging.getLogger().addHandler(handler)
+    except OSError as e:
+        log.warning("per-job log file unavailable: %s", e)
+
     # fresh process: the persistent XLA cache turns the cold jit into a read
-    get_config().enable_compilation_cache()
+    cfg.enable_compilation_cache()
     runner = JobRunner(args.job_id, port=args.port).start()
     # the parent reads this line to learn the bound port (job_pod readiness)
     print(f"LISTENING {runner.service.port}", flush=True)

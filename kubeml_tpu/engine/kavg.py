@@ -135,6 +135,11 @@ class KAvgTrainer:
         self._rep_cache: Dict[int, Any] = {}  # replica-0 replicated extractors
         self._place_cache: Dict[int, Any] = {}  # reference-broadcast placers
         self._meshes: Dict[int, Mesh] = {}
+        # background AOT compiles for elastic scale-up (see precompile_async)
+        import threading as _threading
+
+        self._cache_lock = _threading.Lock()
+        self._precompile_thread = None
 
     # --- mesh / placement ---
 
@@ -402,16 +407,22 @@ class KAvgTrainer:
         epoch_key = int(epoch) if self.model.epoch_in_schedule else 0
         # dtype is part of the key: staged rounds arrive pre-cast to bf16 while
         # unstaged ones are f32, and the two trace differently
-        key = (n, steps, batch_x.shape[2:], str(batch_x.dtype),
-               batch_y.shape[2:], str(batch_y.dtype), float(lr), epoch_key)
-        fn = self._train_cache.get(key)
-        if fn is None:
-            fn = self._build_sync_round(n, steps, float(lr), int(epoch))
-            self._train_cache[key] = fn
-            log.info(
-                "compiling sync_round: n=%d steps=%d batch=%s lr=%g", n, steps,
-                batch_x.shape[2:], lr,
-            )
+        # dtypes are canonicalized (int64 -> int32 without x64) so a key built
+        # from raw host arrays matches one built from staged device arrays
+        key = (n, steps, tuple(batch_x.shape[2:]),
+               str(jax.dtypes.canonicalize_dtype(batch_x.dtype)),
+               tuple(batch_y.shape[2:]),
+               str(jax.dtypes.canonicalize_dtype(batch_y.dtype)),
+               float(lr), epoch_key)
+        with self._cache_lock:
+            fn = self._train_cache.get(key)
+            if fn is None:
+                fn = self._build_sync_round(n, steps, float(lr), int(epoch))
+                self._train_cache[key] = fn
+                log.info(
+                    "compiling sync_round: n=%d steps=%d batch=%s lr=%g", n, steps,
+                    batch_x.shape[2:], lr,
+                )
         return fn(
             stacked_vars,
             jnp.asarray(batch_x),
@@ -420,6 +431,113 @@ class KAvgTrainer:
             jnp.asarray(worker_mask, jnp.float32),
             rng,
         )
+
+    def precompile_async(
+        self,
+        stacked_vars,
+        n_next: int,
+        steps: int,
+        batch_shape: Tuple[int, ...],
+        x_dtype,
+        label_shape: Tuple[int, ...],
+        y_dtype,
+        lr: float,
+        epoch: int = 0,
+    ) -> bool:
+        """AOT-compile the sync_round for a FUTURE parallelism level on a
+        background thread, so elastic scale-up pays a compile-cache read
+        instead of a synchronous recompile stall (the failure mode that capped
+        round 1's unbounded elastic scenario — BASELINE.md). ``batch_shape`` /
+        ``label_shape`` are ``(B, *dims)`` exactly as a staged slab's
+        ``shape[2:]`` — they must reproduce sync_round's cache key.
+
+        Returns False (and does nothing) when the level is already compiled or
+        another precompile is in flight; at most one background compile runs.
+        The compiled executable lands in the jit dispatch cache AND the
+        persistent XLA disk cache — either way the later live call is a read."""
+        if not label_shape or label_shape[0] != batch_shape[0]:
+            raise ValueError(
+                f"label_shape {label_shape} must start with the batch dim "
+                f"{batch_shape[0]} (pass batch_y.shape[2:])"
+            )
+        # canonicalized dtypes, matching sync_round's key (the live slabs are
+        # staged device arrays: int64 labels arrive as int32)
+        x_dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(x_dtype))
+        y_dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(y_dtype))
+        epoch_key = int(epoch) if self.model.epoch_in_schedule else 0
+        key = (n_next, steps, tuple(batch_shape), str(x_dtype),
+               tuple(label_shape), str(y_dtype), float(lr), epoch_key)
+        with self._cache_lock:
+            if key in self._train_cache:
+                return False
+            if self._precompile_thread is not None and self._precompile_thread.is_alive():
+                return False
+            fn = self._build_sync_round(n_next, steps, float(lr), int(epoch))
+            self._train_cache[key] = fn
+
+        sharded, replicated = self._shardings(n_next)
+
+        def sds(shape, dtype, sharding):
+            return jax.ShapeDtypeStruct(shape, jnp.dtype(dtype), sharding=sharding)
+
+        vars_spec = jax.tree.map(
+            lambda leaf: sds((n_next,) + leaf.shape[1:], leaf.dtype, sharded),
+            stacked_vars,
+        )
+        x_spec = sds((n_next, steps) + tuple(batch_shape), x_dtype, sharded)
+        y_spec = sds((n_next, steps) + tuple(label_shape), y_dtype, sharded)
+        m_spec = sds((n_next, steps, batch_shape[0]), jnp.float32, sharded)
+        wm_spec = sds((n_next,), jnp.float32, replicated)
+        rng_ex = jax.random.PRNGKey(0)
+        rng_spec = sds(rng_ex.shape, rng_ex.dtype, replicated)
+
+        import threading as _threading
+
+        def work():
+            try:
+                fn.lower(vars_spec, x_spec, y_spec, m_spec, wm_spec,
+                         rng_spec).compile()
+                log.info("precompiled sync_round for n=%d (background)", n_next)
+            except Exception:
+                log.exception("background precompile for n=%d failed "
+                              "(non-fatal; live path will compile)", n_next)
+
+        self._precompile_thread = _threading.Thread(
+            target=work, name=f"precompile-n{n_next}", daemon=True
+        )
+        self._precompile_thread.start()
+        return True
+
+    def round_flops(self, stacked_vars, x, y, mask, lr: float,
+                    epoch: int = 0) -> Optional[float]:
+        """FLOPs of one sync round, from XLA's own cost analysis.
+
+        XLA counts a ``lax.scan`` body ONCE regardless of trip count (verified
+        on v5e: identical totals for k=1/2/8), so this lowers a 1-step variant
+        of the program and scales by k — robust even if a future XLA starts
+        multiplying by the (static) trip count, since a 1-step program is the
+        same either way. The merge's own FLOPs (~3 x params) are counted k
+        times; negligible against the conv/matmul body."""
+        n, k = x.shape[0], x.shape[1]
+        fn1 = self._build_sync_round(n, 1, float(lr), int(epoch))
+        sharded, replicated = self._shardings(n)
+
+        def sds(shape, dtype, sh):
+            return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype), sharding=sh)
+
+        vars_spec = jax.tree.map(
+            lambda leaf: sds(leaf.shape, leaf.dtype, sharded), stacked_vars
+        )
+        x1 = sds((n, 1) + tuple(x.shape[2:]), x.dtype, sharded)
+        y1 = sds((n, 1) + tuple(y.shape[2:]), y.dtype, sharded)
+        m1 = sds((n, 1) + tuple(mask.shape[2:]), jnp.float32, sharded)
+        wm = sds((n,), jnp.float32, replicated)
+        rng_ex = jax.random.PRNGKey(0)
+        rngs = sds(rng_ex.shape, rng_ex.dtype, replicated)
+        from ..benchmarks.mfu import compiled_flops
+
+        flops = compiled_flops(fn1, vars_spec, x1, y1, m1, wm, rngs)
+        return flops * k if flops is not None else None
 
     # --- validation / inference ---
 

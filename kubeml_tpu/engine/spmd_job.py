@@ -9,12 +9,17 @@ token-id arrays ``[N, L]`` in the same shard store.
 
 Differences from the K-AVG job, by design:
 
-* parallelism is the mesh (fixed for the job's life): no elastic re-meshing,
-  no scheduler round-trip — ``JobState.parallelism`` reports the device count;
+* parallelism is the data-parallel axis of the mesh: elastic re-meshing
+  between epochs resizes ``dp`` (more/fewer devices) while the model axes
+  (tp/sp/ep) stay fixed — the scheduler round-trip is the same epoch-end hook
+  the K-AVG job uses, and ``JobState.parallelism`` reports devices in use;
 * the objective is next-token LM loss (kubeml_tpu.parallel.trainer.lm_loss)
   unless the model overrides ``per_sample_loss`` is irrelevant here — language
   modeling trains on the tokens themselves, labels in the store are ignored;
-* validation reports eval loss (no accuracy — goal_accuracy does not apply).
+* validation reports eval loss AND next-token top-1 accuracy;
+  ``goal_accuracy`` early-stops on that accuracy (%), and the SPMD-specific
+  ``goal_loss`` early-stops on eval loss (a perplexity target P is
+  ``goal_loss = ln(P)``).
 
 The user's ``build()`` may read ``self.mesh`` (set by this job before the
 module is built) to construct a mesh-aware module, e.g.
@@ -55,7 +60,7 @@ class SPMDJob:
         store: Optional[ShardStore] = None,
         history_store: Optional[HistoryStore] = None,
         checkpoint_store: Optional[CheckpointStore] = None,
-        on_epoch_end=None,  # accepted for TrainJob interface parity; unused
+        on_epoch_end=None,  # scheduler hook driving elastic dp re-meshing
         on_metrics=None,
         devices=None,
         seed: int = 0,
@@ -69,25 +74,20 @@ class SPMDJob:
         self.store = store or ShardStore()
         self.history_store = history_store or HistoryStore()
         self._checkpoint_store = checkpoint_store
+        self.on_epoch_end = on_epoch_end
         self.on_metrics = on_metrics
         self.seed = seed
         self.tracer = get_tracer()
 
-        devices = list(devices if devices is not None else jax.devices())
-        shape = mesh_shape_for(len(devices), **(request.options.mesh_shape or {}))
-        self.mesh = make_mesh(shape=shape, devices=devices)
+        self._all_devices = list(devices if devices is not None else jax.devices())
+        shape = mesh_shape_for(len(self._all_devices),
+                               **(request.options.mesh_shape or {}))
+        # model axes are fixed for the job's life; elasticity moves dp only
+        self._model_axes = {ax: s for ax, s in shape.items() if ax != "dp"}
+        self.mesh = make_mesh(shape=shape, devices=self._all_devices)
         # the user's build() may read self.mesh to construct a mesh-aware module
         model.mesh = self.mesh
-        self.trainer = SPMDTrainer(
-            model.module,
-            self.mesh,
-            optimizer=model.configure_optimizers(),
-            precision=request.options.precision,
-            donate=request.options.donate,
-            # the KubeModel device-side input pipeline (runtime/model.py
-            # preprocess) applies under this engine too, not just K-AVG
-            input_transform=model.preprocess,
-        )
+        self.trainer = self._make_trainer(self.mesh)
 
         self.history = History(id=job_id, task={"request": request.to_dict()})
         self.stop_event = threading.Event()
@@ -96,6 +96,18 @@ class SPMDJob:
         # live inference and a donating train step must not touch the same
         # buffers concurrently (donation invalidates the inputs)
         self._step_lock = threading.Lock()
+
+    def _make_trainer(self, mesh) -> SPMDTrainer:
+        return SPMDTrainer(
+            self.model.module,
+            mesh,
+            optimizer=self.model.configure_optimizers(),
+            precision=self.request.options.precision,
+            donate=self.request.options.donate,
+            # the KubeModel device-side input pipeline (runtime/model.py
+            # preprocess) applies under this engine too, not just K-AVG
+            input_transform=self.model.preprocess,
+        )
 
     # --- TrainJob surface ---
 
@@ -168,22 +180,52 @@ class SPMDJob:
                 train_loss = float(np.mean([float(l) for l in losses]))
                 elapsed = time.time() - t0
 
+                used_devices = self.mesh.devices.size
+
                 val_loss = None
+                acc_pct = None
                 if opts.validate_every > 0 and (epoch + 1) % opts.validate_every == 0:
-                    val_loss = self._validate()
+                    val_loss, token_acc = self._validate()
+                    if token_acc is not None:
+                        acc_pct = token_acc * 100.0
 
                 self.history.append_epoch(
                     train_loss=train_loss,
-                    parallelism=self.mesh.devices.size,
+                    parallelism=used_devices,
                     duration=elapsed,
                     validation_loss=val_loss,
+                    accuracy=acc_pct,
                 )
-                self._push_metrics(train_loss, val_loss, elapsed)
-                log.info("%s: epoch %d/%d loss=%.4f val=%s %.2fs", self.job_id,
-                         epoch + 1, req.epochs, train_loss,
-                         f"{val_loss:.4f}" if val_loss is not None else "-", elapsed)
+                self._push_metrics(train_loss, val_loss, acc_pct, elapsed,
+                                   used_devices)
+                log.info("%s: epoch %d/%d loss=%.4f val=%s acc=%s %.2fs",
+                         self.job_id, epoch + 1, req.epochs, train_loss,
+                         f"{val_loss:.4f}" if val_loss is not None else "-",
+                         f"{acc_pct:.2f}%" if acc_pct is not None else "-",
+                         elapsed)
                 if opts.checkpoint_every > 0 and (epoch + 1) % opts.checkpoint_every == 0:
                     self._save_checkpoint(epoch)
+
+                # goal metrics (K-AVG parity job.go:49-54 + the SPMD-native
+                # eval-loss goal: a perplexity target P is goal_loss = ln P)
+                if acc_pct is not None and acc_pct >= opts.goal_accuracy:
+                    log.info("%s: goal accuracy %.2f%% reached (%.2f%%)",
+                             self.job_id, opts.goal_accuracy, acc_pct)
+                    break
+                if (opts.goal_loss > 0.0 and val_loss is not None
+                        and val_loss <= opts.goal_loss):
+                    log.info("%s: goal eval loss %.4f reached (%.4f)",
+                             self.job_id, opts.goal_loss, val_loss)
+                    break
+
+                # elastic dp re-meshing between epochs (the same scheduler
+                # hook the K-AVG job uses; parallelism = devices in use)
+                if not opts.static_parallelism and self.on_epoch_end is not None:
+                    new_p = self.on_epoch_end(
+                        JobState(parallelism=used_devices, elapsed_time=elapsed)
+                    )
+                    if new_p:
+                        self._maybe_remesh(new_p, rng, first)
 
             if opts.save_model and self.history.train_loss:
                 self.checkpoint_store.save(
@@ -226,12 +268,47 @@ class SPMDJob:
                  ck.tag, start_epoch)
         return start_epoch
 
-    def _validate(self) -> Optional[float]:
-        vals = []
+    def _validate(self):
+        """Mean (eval loss, next-token accuracy) over the test split."""
+        losses, accs = [], []
         with self.tracer.span("job.validate", job=self.job_id, engine="spmd"):
             for batch in self._token_batches("test", self.request.batch_size):
-                vals.append(self.trainer.eval_loss(batch))  # enters the mesh itself
-        return float(np.mean(vals)) if vals else None
+                l, a = self.trainer.eval_metrics(batch)  # enters the mesh itself
+                losses.append(l)
+                accs.append(a)
+        if not losses:
+            return None, None
+        return float(np.mean(losses)), float(np.mean(accs))
+
+    def _maybe_remesh(self, new_p: int, rng, sample_batch) -> None:
+        """Elastic dp resize between epochs: keep the model axes, change the
+        device count. The params host-bounce onto the new mesh (the same
+        replicate-then-place move the K-AVG multi-host resize makes) and the
+        optimizer state restarts — consistent with K-AVG's per-sync optimizer
+        reset (reference semantics network.py:121-128). The step recompiles
+        per mesh shape; the persistent XLA cache makes revisited levels a
+        read."""
+        model = max(1, int(np.prod(list(self._model_axes.values()))))
+        devices_new = max(model, (min(new_p, len(self._all_devices)) // model) * model)
+        if devices_new == self.mesh.devices.size:
+            return
+        dp_new = devices_new // model
+        log.info("%s: elastic re-mesh %d -> %d devices (dp=%d, model axes %s)",
+                 self.job_id, self.mesh.devices.size, devices_new, dp_new,
+                 self._model_axes or "{}")
+        host = self._host_params()
+        shape = dict(self._model_axes, dp=dp_new)
+        self.mesh = make_mesh(shape=shape, devices=self._all_devices[:devices_new])
+        self.model.mesh = self.mesh
+        with self._step_lock:
+            self.trainer = self._make_trainer(self.mesh)
+            self.trainer.init(rng, sample_batch)  # shardings + fresh opt state
+            import flax.core.meta as meta
+
+            unboxed = meta.unbox(self.trainer.params)
+            shardings = jax.tree.map(lambda x: x.sharding, unboxed)
+            placed = jax.device_put(host, shardings)
+            self.trainer.params = meta.replace_boxed(self.trainer.params, placed)
 
     def _host_params(self):
         import flax.linen as nn
@@ -262,14 +339,15 @@ class SPMDJob:
         except Exception:
             log.exception("%s: checkpoint save failed (non-fatal)", self.job_id)
 
-    def _push_metrics(self, train_loss, val_loss, elapsed) -> None:
+    def _push_metrics(self, train_loss, val_loss, acc_pct, elapsed, parallelism) -> None:
         if self.on_metrics is None:
             return
         try:
             self.on_metrics(MetricUpdate(
                 job_id=self.job_id, train_loss=float(train_loss),
                 validation_loss=float(val_loss) if val_loss is not None else 0.0,
-                accuracy=0.0, parallelism=self.mesh.devices.size,
+                accuracy=float(acc_pct) if acc_pct is not None else 0.0,
+                parallelism=parallelism,
                 epoch_duration=float(elapsed),
             ))
         except Exception:

@@ -24,17 +24,18 @@ import jax
 import jax.numpy as jnp
 
 # Auto-dispatch threshold for the Pallas flash kernel, tuned on the TRAINING
-# path, re-measured on v5e with a reliable value-fetch barrier (2026-07-30).
-# The isolated attention op favors XLA at every length (fwd+bwd B=4 H=8 D=64
-# bf16 causal, xla vs pallas ms/step: L=1024: 14.6/10.2, L=2048: 13.7/14.9,
-# L=4096: 27.7/32.5, L=8192: 82.9/104.8) — but inside a full rematerialized
-# training step (GPT 8x512, jax.checkpoint, 16k-token steps) the ordering
-# flips hard at long context, because remat recomputes the backward's
-# attention and XLA's fusion then materializes the L^2 scores through HBM
-# while the flash custom call recomputes tiles in VMEM. Measured end-to-end
-# tokens/sec, xla vs pallas: L=1024: 145k/127k, L=2048: 103k/91k,
-# L=4096: 15.4k/54.4k (3.5x), L=8192: 4.1k/29.4k (7.3x). Structured-mask
-# callers at KV length >= this threshold get the kernel; None disables.
+# path on v5e with a reliable value-fetch barrier. Inside a full
+# rematerialized training step (GPT 8x512, jax.checkpoint, 16k-token steps)
+# XLA's fused attention wins at short context but collapses at long context —
+# remat recomputes the backward's attention and XLA then materializes the L^2
+# scores through HBM, while the flash kernels (Pallas forward AND the
+# FlashAttention-2 Pallas backward, ops/flash_attention.py) stream tiles in
+# VMEM. Measured end-to-end tokens/sec with the Pallas backward (2026-07-30,
+# /tmp command: python -m kubeml_tpu.benchmarks.longcontext with the
+# threshold forced per column; table in BASELINE.md), xla vs pallas:
+# L=1024: 142k/127k, L=2048: 99k/96k, L=4096: 15.4k/59.0k (3.8x),
+# L=8192: 4.1k/34.9k (8.6x). Structured-mask callers at KV length >= this
+# threshold get the kernel; None disables.
 FLASH_MIN_KV_LEN = 4096
 
 

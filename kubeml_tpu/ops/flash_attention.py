@@ -14,9 +14,14 @@ a ``fori_loop`` (causal walks only up to the diagonal). Padding to block
 multiples happens in the wrapper; padded keys are masked via the ``kv_valid``
 lane so odd sequence lengths are exact.
 
-Backward runs as an XLA recompute of the reference attention (standard
-rematerialized-backward trade: forward saves only q/k/v, not scores). A full
-Pallas backward kernel is a further optimization, not a semantic change.
+Backward is a pair of Pallas kernels (FlashAttention-2 style): the forward
+additionally writes the per-row logsumexp, and the backward recomputes P
+tile-by-tile in VMEM from (q, k, lse) — so the ``[L, L]`` score matrix never
+exists in HBM in EITHER direction. ``_dq_kernel`` walks K/V blocks per q-block
+(like the forward); ``_dkv_kernel`` walks Q/dO blocks per k-block, so every
+output block is produced by exactly one program and no cross-program
+accumulation is needed. The row term ``D = rowsum(dO * O)`` is a cheap
+elementwise XLA op outside the kernels.
 
 Set ``interpret=True`` (automatic off-TPU) to run the same kernel on CPU for
 tests.
@@ -39,8 +44,10 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, *, causal: bool, block_k: int):
-    """One (batch, head, q-block) program: online softmax over K/V blocks."""
+def _fa_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, lse_ref, *, causal: bool,
+               block_k: int):
+    """One (batch, head, q-block) program: online softmax over K/V blocks.
+    Also writes the per-row logsumexp (the backward's softmax residual)."""
     q = q_ref[0, 0].astype(jnp.float32)  # [BQ, D]
     bq, d = q.shape
     lk = k_ref.shape[2]
@@ -78,7 +85,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, *, causal: bool, block_k: 
         n_blocks = jnp.minimum((q_start + bq + block_k - 1) // block_k, lk // block_k)
     else:
         n_blocks = lk // block_k
-    acc, _, l = jax.lax.fori_loop(
+    acc, m, l = jax.lax.fori_loop(
         0,
         n_blocks,
         body,
@@ -89,32 +96,43 @@ def _fa_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, *, causal: bool, block_k: 
         ),
     )
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-9)).astype(o_ref.dtype)
+    # logsumexp per row; fully-masked rows keep a huge-negative lse so the
+    # backward's exp(s - lse) stays zero through the same s <= _NEG/2 guard.
+    # (rank-4 [B, H, 1, Lqp] with a unit axis: Mosaic's (8, 128) tile rule
+    # wants the block's second-minor dim to equal the array dim)
+    lse_ref[0, 0, 0] = (m + jnp.log(jnp.maximum(l, 1e-9)))[:, 0]
 
 
-def _flash_fwd_impl(q, k, v, valid, *, causal: bool, block_q: int, block_k: int,
-                    interpret: bool):
-    b, lq, h, d = q.shape
-    lk = k.shape[1]
+def _blocks_for(lq: int, lk: int, block_q: int, block_k: int, interpret: bool):
     # Mosaic requires 128-lane tiles on real hardware, so blocks are at least
     # (128, 128) there (short sequences just pad up); interpret mode keeps
     # small blocks so tests can exercise the multi-block recurrence cheaply.
     min_blk = 8 if interpret else 128
     bq = max(min(block_q, _round_up(lq, 8)), min_blk)
     bk = max(min(block_k, _round_up(lk, 8)), min_blk)
-    lqp, lkp = _round_up(lq, bq), _round_up(lk, bk)
+    return bq, bk, _round_up(lq, bq), _round_up(lk, bk)
 
-    # [B, L, H, D] -> [B, H, L, D] padded to block multiples; padded keys are
-    # marked invalid so odd lengths stay exact, padded queries are sliced off.
-    def prep(t, lp):
-        t = jnp.moveaxis(t, 2, 1)
-        return jnp.pad(t, ((0, 0), (0, 0), (0, lp - t.shape[2]), (0, 0)))
 
-    qt, kt, vt = prep(q, lqp), prep(k, lkp), prep(v, lkp)
+def _prep(t, lp):
+    """[B, L, H, D] -> [B, H, Lp, D], zero-padded on the length axis."""
+    t = jnp.moveaxis(t, 2, 1)
+    return jnp.pad(t, ((0, 0), (0, 0), (0, lp - t.shape[2]), (0, 0)))
+
+
+def _flash_fwd_impl(q, k, v, valid, *, causal: bool, block_q: int, block_k: int,
+                    interpret: bool, return_lse: bool = False):
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    bq, bk, lqp, lkp = _blocks_for(lq, lk, block_q, block_k, interpret)
+
+    # padded keys are marked invalid so odd lengths stay exact; padded queries
+    # are sliced off after the call
+    qt, kt, vt = _prep(q, lqp), _prep(k, lkp), _prep(v, lkp)
     # [B, 1, Lkp]: a unit middle axis keeps the block's trailing dims equal to
     # the array dims, satisfying the Mosaic (8, 128) tiling rule for any B
     valid_p = jnp.pad(valid.astype(jnp.float32), ((0, 0), (0, lkp - lk)))[:, None, :]
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_fa_kernel, causal=causal, block_k=bk),
         grid=(b, h, lqp // bq),
         in_specs=[
@@ -123,11 +141,181 @@ def _flash_fwd_impl(q, k, v, valid, *, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, 1, lkp, d), lambda i, j, n: (i, j, 0, 0)),
             pl.BlockSpec((1, 1, lkp), lambda i, j, n: (i, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda i, j, n: (i, j, n, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda i, j, n: (i, j, 0, n)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, lqp, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, 1, lqp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, valid_p)
+    out = jnp.moveaxis(out[:, :, :lq], 1, 2)
+    if return_lse:
+        return out, lse  # lse stays padded [B, H, 1, Lqp] for the backward
+    return out
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, valid_ref, lse_ref, do_ref, dsum_ref, dq_ref,
+               *, causal: bool, block_k: int):
+    """dQ for one (batch, head, q-block): walk K/V blocks, recompute P from
+    (q, k, lse), accumulate dS @ K (FlashAttention-2 backward, dQ half)."""
+    q = q_ref[0, 0].astype(jnp.float32)      # [BQ, D]
+    do = do_ref[0, 0].astype(jnp.float32)    # [BQ, D]
+    lse = lse_ref[0, 0, 0][:, None]          # [BQ, 1]
+    dsum = dsum_ref[0, 0, 0][:, None]        # [BQ, 1]
+    bq, d = q.shape
+    lk = k_ref.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    q_start = pl.program_id(2) * bq
+
+    def body(j, acc):
+        off = pl.multiple_of(j * block_k, block_k)
+        k_blk = k_ref[0, 0, pl.ds(off, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(off, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        valid_blk = valid_ref[0, 0:1, pl.ds(off, block_k)]
+        s = jnp.where(valid_blk > 0, s, _NEG)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG)
+        p = jnp.where(s <= _NEG / 2, 0.0, jnp.exp(s - lse))  # [BQ, BK]
+        dp = jax.lax.dot_general(  # dO @ V^T -> [BQ, BK]
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - dsum) * scale
+        return acc + jax.lax.dot_general(  # dS @ K -> [BQ, D]
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        n_blocks = jnp.minimum((q_start + bq + block_k - 1) // block_k, lk // block_k)
+    else:
+        n_blocks = lk // block_k
+    acc = jax.lax.fori_loop(0, n_blocks, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0] = acc.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, valid_ref, lse_ref, do_ref, dsum_ref,
+                dk_ref, dv_ref, *, causal: bool, block_q: int):
+    """dK/dV for one (batch, head, k-block): walk Q/dO blocks. Each output
+    block is produced by exactly one program — no cross-program accumulation."""
+    k_blk = k_ref[0, 0].astype(jnp.float32)  # [BK, D]
+    v_blk = v_ref[0, 0].astype(jnp.float32)  # [BK, D]
+    bk, d = k_blk.shape
+    lq = q_ref.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    k_start = pl.program_id(2) * bk
+    valid_blk = valid_ref[0, 0:1, :]  # [1, BK] (blocked spec)
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        off = pl.multiple_of(i * block_q, block_q)
+        q_blk = q_ref[0, 0, pl.ds(off, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, 0, pl.ds(off, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, 0, 0, pl.ds(off, block_q)][:, None]    # [BQ, 1]
+        dsum_blk = dsum_ref[0, 0, 0, pl.ds(off, block_q)][:, None]  # [BQ, 1]
+        s = jax.lax.dot_general(  # [BQ, BK]
+            q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        s = jnp.where(valid_blk > 0, s, _NEG)
+        if causal:
+            q_pos = off + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG)
+        p = jnp.where(s <= _NEG / 2, 0.0, jnp.exp(s - lse_blk))  # [BQ, BK]
+        dv_acc = dv_acc + jax.lax.dot_general(  # P^T @ dO -> [BK, D]
+            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(  # dO @ V^T -> [BQ, BK]
+            do_blk, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - dsum_blk) * scale
+        dk_acc = dk_acc + jax.lax.dot_general(  # dS^T @ Q -> [BK, D]
+            ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk_acc, dv_acc
+
+    if causal:
+        # q-blocks strictly above this k-block's diagonal contribute nothing
+        start = k_start // block_q
+        n_blocks = lq // block_q
+        init = (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32))
+        dk_acc, dv_acc = jax.lax.fori_loop(start, n_blocks, body, init)
+    else:
+        init = (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32))
+        dk_acc, dv_acc = jax.lax.fori_loop(0, lq // block_q, body, init)
+    dk_ref[0, 0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, valid, lse, out, do, *, causal: bool, block_q: int,
+                    block_k: int, interpret: bool):
+    """Pallas backward: dq from the q-grid kernel, dk/dv from the k-grid one.
+    The score matrix is recomputed tile-by-tile in VMEM — the HBM residuals
+    are O(L) (q, k, v, out, lse), never the [L, L] scores. ``lse`` arrives
+    padded [B, H, Lqp] straight from the forward."""
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    bq, bk, lqp, lkp = _blocks_for(lq, lk, block_q, block_k, interpret)
+
+    qt, kt, vt = _prep(q, lqp), _prep(k, lkp), _prep(v, lkp)
+    dot = _prep(do, lqp)
+    valid_p = jnp.pad(valid.astype(jnp.float32), ((0, 0), (0, lkp - lk)))[:, None, :]
+    # D_i = rowsum(dO * O) — cheap elementwise XLA on the saved output;
+    # padded rows get dO = 0 so they contribute nothing to dK/dV
+    dsum = jnp.moveaxis((do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1),
+                        2, 1)  # [B, H, Lq]
+    dsum = jnp.pad(dsum, ((0, 0), (0, 0), (0, lqp - lq)))[:, :, None, :]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, block_k=bk),
+        grid=(b, h, lqp // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda i, j, n: (i, j, n, 0)),
+            pl.BlockSpec((1, 1, lkp, d), lambda i, j, n: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, lkp, d), lambda i, j, n: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, lkp), lambda i, j, n: (i, 0, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda i, j, n: (i, j, 0, n)),
+            pl.BlockSpec((1, 1, bq, d), lambda i, j, n: (i, j, n, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda i, j, n: (i, j, 0, n)),
+        ],
         out_specs=pl.BlockSpec((1, 1, bq, d), lambda i, j, n: (i, j, n, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, lqp, d), q.dtype),
         interpret=interpret,
-    )(qt, kt, vt, valid_p)
-    return jnp.moveaxis(out[:, :, :lq], 1, 2)
+    )(qt, kt, vt, valid_p, lse, dot, dsum)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, block_q=bq),
+        grid=(b, h, lkp // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda i, j, n: (i, j, n, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda i, j, n: (i, j, n, 0)),
+            pl.BlockSpec((1, 1, lqp, d), lambda i, j, n: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, bk), lambda i, j, n: (i, 0, n)),
+            pl.BlockSpec((1, 1, 1, lqp), lambda i, j, n: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, lqp, d), lambda i, j, n: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, 1, lqp), lambda i, j, n: (i, j, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda i, j, n: (i, j, n, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda i, j, n: (i, j, n, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, lkp, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, lkp, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(kt, vt, qt, valid_p, lse, dot, dsum)
+
+    dq = jnp.moveaxis(dq[:, :, :lq], 1, 2)
+    dk = jnp.moveaxis(dk[:, :, :lk], 1, 2)
+    dv = jnp.moveaxis(dv[:, :, :lk], 1, 2)
+    return dq, dk, dv
 
 
 def _xla_reference(q, k, v, valid, causal: bool):
@@ -146,14 +334,18 @@ def _flash(causal, block_q, block_k, interpret, q, k, v, valid):
 
 
 def _flash_fwd(causal, block_q, block_k, interpret, q, k, v, valid):
-    out = _flash(causal, block_q, block_k, interpret, q, k, v, valid)
-    return out, (q, k, v, valid)
+    out, lse = _flash_fwd_impl(q, k, v, valid, causal=causal, block_q=block_q,
+                               block_k=block_k, interpret=interpret,
+                               return_lse=True)
+    return out, (q, k, v, valid, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v, valid = res
-    _, vjp = jax.vjp(lambda q, k, v: _xla_reference(q, k, v, valid, causal), q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, valid, out, lse = res
+    dq, dk, dv = _flash_bwd_impl(
+        q, k, v, valid, lse, out, g, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=interpret,
+    )
     return dq, dk, dv, jnp.zeros_like(valid, dtype=jnp.float32)
 
 

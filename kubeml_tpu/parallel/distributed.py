@@ -102,6 +102,36 @@ def num_slices() -> int:
     return max(1, len(slices))
 
 
+def hybrid_mesh_shapes(
+    shape: Dict[str, int], n_slices: int, n_devices: int, dcn_axis: str = "dp"
+) -> Tuple[Tuple[str, ...], list, list]:
+    """Pure layout math for the DCN-aware hybrid mesh: (axis names,
+    per-slice ICI shape, across-slice DCN shape). Factored out of
+    :func:`global_mesh` so multi-slice layouts are testable without multi-slice
+    hardware (CPU reports one slice)."""
+    if dcn_axis not in shape:
+        raise ValueError(
+            f"dcn_axis {dcn_axis!r} missing from mesh shape {shape}; on a "
+            f"{n_slices}-slice topology one axis must span the slices"
+        )
+    per_slice = n_devices // n_slices
+    model = int(np.prod([s for ax, s in shape.items() if ax != dcn_axis]))
+    if per_slice % model != 0:
+        raise ValueError(
+            f"model axes use {model} devices which does not divide the "
+            f"{per_slice}-device slice; keep tp/sp/ep/pp within one slice"
+        )
+    if shape[dcn_axis] % n_slices != 0:
+        raise ValueError(
+            f"{dcn_axis}={shape[dcn_axis]} must be divisible by the "
+            f"{n_slices} slices it spans"
+        )
+    names = tuple(ax for ax in AXIS_ORDER if ax in shape)
+    ici_shape = [shape[ax] // n_slices if ax == dcn_axis else shape[ax] for ax in names]
+    dcn_shape = [n_slices if ax == dcn_axis else 1 for ax in names]
+    return names, ici_shape, dcn_shape
+
+
 def global_mesh(
     shape: Optional[Dict[str, int]] = None,
     dcn_axis: str = "dp",
@@ -123,26 +153,9 @@ def global_mesh(
 
     from jax.experimental import mesh_utils
 
-    if dcn_axis not in shape:
-        raise ValueError(
-            f"dcn_axis {dcn_axis!r} missing from mesh shape {shape}; on a "
-            f"{n_slices}-slice topology one axis must span the slices"
-        )
-    per_slice = len(devices) // n_slices
-    model = int(np.prod([s for ax, s in shape.items() if ax != dcn_axis]))
-    if per_slice % model != 0:
-        raise ValueError(
-            f"model axes use {model} devices which does not divide the "
-            f"{per_slice}-device slice; keep tp/sp/ep/pp within one slice"
-        )
-    if shape[dcn_axis] % n_slices != 0:
-        raise ValueError(
-            f"{dcn_axis}={shape[dcn_axis]} must be divisible by the "
-            f"{n_slices} slices it spans"
-        )
-    names = tuple(ax for ax in AXIS_ORDER if ax in shape)
-    ici_shape = [shape[ax] // n_slices if ax == dcn_axis else shape[ax] for ax in names]
-    dcn_shape = [n_slices if ax == dcn_axis else 1 for ax in names]
+    names, ici_shape, dcn_shape = hybrid_mesh_shapes(
+        shape, n_slices, len(devices), dcn_axis
+    )
     grid = mesh_utils.create_hybrid_device_mesh(
         ici_shape, dcn_shape, devices=devices
     )

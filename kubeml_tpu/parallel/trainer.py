@@ -160,10 +160,23 @@ class SPMDTrainer:
 
     # --- eval ---
 
-    def eval_loss(self, batch: np.ndarray) -> float:
+    def eval_metrics(self, batch: np.ndarray, pad_id: int = 0) -> Tuple[float, float]:
+        """(eval loss, next-token top-1 accuracy) over non-pad positions — the
+        SPMD engine's accuracy-style validation (K-AVG parity: the reference
+        validates accuracy every epoch, ml/pkg/train/job.go:339-362)."""
         x = jnp.asarray(batch)
         if self.input_transform is not None:
             x = self.input_transform(x)
         with jax.set_mesh(self.mesh):
             logits = self.module.apply(self.params, x, train=False)
-            return float(self.loss_fn(jnp.asarray(logits, jnp.float32), jnp.asarray(batch)))
+            logits = jnp.asarray(logits, jnp.float32)
+            tokens = jnp.asarray(batch)
+            loss = float(self.loss_fn(logits, tokens))
+            targets = tokens[:, 1:]
+            mask = (targets != pad_id).astype(jnp.float32)
+            correct = (jnp.argmax(logits[:, :-1], axis=-1) == targets).astype(jnp.float32)
+            acc = float((correct * mask).sum() / jnp.maximum(mask.sum(), 1.0))
+        return loss, acc
+
+    def eval_loss(self, batch: np.ndarray) -> float:
+        return self.eval_metrics(batch)[0]

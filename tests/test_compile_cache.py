@@ -56,3 +56,63 @@ def test_remat_model_matches_plain(rng):
     gr = jax.grad(lambda v: loss(remat, v))(variables)
     for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gr)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_precompile_async_matches_live_compile(rng):
+    """Background-precompiled sync_round at a future parallelism level must be
+    picked up by the live path (same cache key) and produce identical numerics
+    to a fresh compile — the compile-cost-aware elasticity mechanism."""
+    import time
+
+    from kubeml_tpu.benchmarks.harness import make_synthetic_model
+    from kubeml_tpu.engine.kavg import KAvgTrainer
+    from kubeml_tpu.models.lenet import LeNet
+
+    def fresh():
+        return make_synthetic_model(LeNet(num_classes=10), "pc")
+
+    r = np.random.default_rng(0)
+    n, k, b = 2, 2, 8
+    x = r.normal(size=(n, k, b, 28, 28, 1)).astype(np.float32)
+    y = r.integers(0, 10, size=(n, k, b)).astype(np.int64)
+    mask = np.ones((n, k, b), np.float32)
+    key = jax.random.PRNGKey(0)
+
+    trainer = KAvgTrainer(fresh(), precision="f32")
+    variables = trainer.init_variables(key, x[0, 0], n)
+    variables, _ = trainer.sync_round(variables, x, y, mask, key, lr=0.1)
+
+    # precompile the doubled level in the background
+    started = trainer.precompile_async(
+        variables, 2 * n, k, (b, 28, 28, 1), np.float32, (b,), np.int64, lr=0.1
+    )
+    assert started
+    # a second request for the same level is a no-op
+    deadline = time.time() + 120
+    while trainer._precompile_thread.is_alive() and time.time() < deadline:
+        time.sleep(0.1)
+    assert not trainer.precompile_async(
+        variables, 2 * n, k, (b, 28, 28, 1), np.float32, (b,), np.int64, lr=0.1
+    )
+
+    # elastic resize onto the precompiled level: the live call must reuse the
+    # cached jitted fn (no new cache entry) and match an independent trainer.
+    # Slabs go through stage_round like production — device_put canonicalizes
+    # int64 labels to int32, and the precompiled key must still match.
+    resized = trainer.resize(variables, n, 2 * n)
+    x2 = np.concatenate([x, x], axis=0)
+    y2 = np.concatenate([y, y], axis=0)
+    m2 = np.ones((2 * n, k, b), np.float32)
+    sx2, sy2, sm2 = trainer.stage_round(x2, y2, m2, 2 * n)
+    assert str(sy2.dtype) == "int32"  # the canonicalization this test guards
+    entries_before = len(trainer._train_cache)
+    out_vars, loss = trainer.sync_round(resized, sx2, sy2, sm2, key, lr=0.1)
+    assert len(trainer._train_cache) == entries_before
+    assert np.isfinite(float(loss))
+
+    other = KAvgTrainer(fresh(), precision="f32")
+    ovars = other.init_variables(key, x[0, 0], n)
+    ovars, _ = other.sync_round(ovars, x, y, mask, key, lr=0.1)
+    ovars = other.resize(ovars, n, 2 * n)
+    _, oloss = other.sync_round(ovars, x2, y2, m2, key, lr=0.1)
+    np.testing.assert_allclose(float(loss), float(oloss), rtol=1e-6)

@@ -103,6 +103,81 @@ def test_flash_gradients_match_xla(rng):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_multiblock(rng, causal):
+    """The Pallas backward must match the XLA vjp across MULTIPLE q/k blocks
+    (interpret-mode blocks are 8, so l=32 walks 4 blocks per grid program) —
+    exercises the causal diagonal-start in the dk/dv kernel and the
+    lse-recomputed P in both kernels."""
+    q, k, v = qkv(rng, b=2, l=32, h=2, d=8)
+    cot = rng.normal(size=q.shape).astype(np.float32)
+
+    def run(fn):
+        _, vjp = jax.vjp(lambda q, k, v: fn(q, k, v, causal=causal), q, k, v)
+        return vjp(jnp.asarray(cot))
+
+    gf = run(flash_attention)
+    gx = run(lambda q, k, v, causal: oracle(q, k, v, causal=causal))
+    for a, b in zip(gf, gx):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+def test_flash_gradients_kv_valid_odd_lengths(rng):
+    """Padded keys (kv_valid) and odd, non-block-multiple lengths must not
+    leak into any gradient — padded-key columns get exactly zero dk/dv."""
+    q, k, v = qkv(rng, b=1, l=19, h=1, d=8, lk=27)
+    valid = np.ones((1, 27), np.float32)
+    valid[:, 21:] = 0.0
+
+    def run(fn):
+        _, vjp = jax.vjp(
+            lambda q, k, v: jnp.sum(fn(q, k, v) ** 2) / 7.0, q, k, v
+        )
+        return vjp(jnp.float32(1.0))
+
+    gf = run(lambda q, k, v: flash_attention(q, k, v, kv_valid=jnp.asarray(valid)))
+    gx = run(lambda q, k, v: oracle(q, k, v, kv_valid=jnp.asarray(valid)))
+    for a, b in zip(gf, gx):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+    # masked-out keys must receive exactly zero gradient
+    assert float(np.abs(np.asarray(gf[1])[:, 21:]).max()) == 0.0
+    assert float(np.abs(np.asarray(gf[2])[:, 21:]).max()) == 0.0
+
+
+def test_flash_gradients_cross_attention(rng):
+    """Lq != Lk gradients (encoder-decoder shape)."""
+    q, k, v = qkv(rng, b=2, l=16, h=2, d=8, lk=48)
+    cot = rng.normal(size=q.shape).astype(np.float32)
+
+    def run(fn):
+        _, vjp = jax.vjp(lambda q, k, v: fn(q, k, v), q, k, v)
+        return vjp(jnp.asarray(cot))
+
+    gf = run(lambda q, k, v: flash_attention(q, k, v))
+    gx = run(lambda q, k, v: oracle(q, k, v))
+    for a, b in zip(gf, gx):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+def test_flash_gradients_bf16_inputs(rng):
+    """bf16 q/k/v (the training dtype) still produce finite, close grads —
+    the kernels accumulate in f32 and cast back."""
+    q, k, v = qkv(rng, b=1, l=16, h=1, d=8, dtype=jnp.bfloat16)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    gf = jax.grad(lambda q, k, v: loss(flash_attention, q, k, v),
+                  argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(lambda q, k, v: loss(
+        lambda q, k, v, causal: oracle(q, k, v, causal=causal), q, k, v),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gx):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), rtol=0.1, atol=0.1)
+
+
 def test_flash_under_jit(rng):
     q, k, v = qkv(rng)
     out = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)

@@ -167,3 +167,72 @@ def test_task_prune_cleans_dead_records(token_store, tmp_config):
     assert ps.prune_tasks() == 1
     assert ps.list_tasks() == []
     assert ps.prune_tasks() == 0
+
+
+def test_spmd_validation_reports_token_accuracy(token_store, tmp_config):
+    """Validation now yields accuracy (next-token top-1) next to eval loss —
+    the accuracy-style hook K-AVG parity requires."""
+    from kubeml_tpu.engine.spmd_job import SPMDJob
+    from kubeml_tpu.functions.registry import FunctionRegistry
+    from kubeml_tpu.storage import CheckpointStore, HistoryStore
+
+    reg = FunctionRegistry(config=tmp_config)
+    reg.create("lmfn", LM_FN)
+    model = reg.load("lmfn")
+    model._set_params(lr=1e-3, batch_size=16, epoch=0, k=1, task="train")
+    job = SPMDJob("spmdacc", _spmd_request(epochs=1), model, store=token_store,
+                  history_store=HistoryStore(config=tmp_config),
+                  checkpoint_store=CheckpointStore(config=tmp_config))
+    hist = job.train()
+    assert len(hist.accuracy) == 1
+    assert 0.0 <= hist.accuracy[0] <= 100.0
+
+
+def test_spmd_goal_loss_early_stop(token_store, tmp_config):
+    """goal_loss (the perplexity goal, ln P) stops the job early once eval
+    loss crosses it — here a trivially high goal stops after epoch 1 of 5."""
+    from kubeml_tpu.engine.spmd_job import SPMDJob
+    from kubeml_tpu.functions.registry import FunctionRegistry
+    from kubeml_tpu.storage import CheckpointStore, HistoryStore
+
+    reg = FunctionRegistry(config=tmp_config)
+    reg.create("lmfn", LM_FN)
+    model = reg.load("lmfn")
+    model._set_params(lr=1e-3, batch_size=16, epoch=0, k=1, task="train")
+    job = SPMDJob("spmdgoal", _spmd_request(epochs=5, options={"goal_loss": 100.0}),
+                  model, store=token_store,
+                  history_store=HistoryStore(config=tmp_config),
+                  checkpoint_store=CheckpointStore(config=tmp_config))
+    hist = job.train()
+    assert len(hist.train_loss) == 1  # stopped after the first validated epoch
+
+
+def test_spmd_elastic_dp_remesh(token_store, tmp_config):
+    """The scheduler hook resizes the dp axis between epochs: model axes stay
+    fixed, devices in use change, training continues and loss stays sane."""
+    from kubeml_tpu.engine.spmd_job import SPMDJob
+    from kubeml_tpu.functions.registry import FunctionRegistry
+    from kubeml_tpu.storage import CheckpointStore, HistoryStore
+
+    reg = FunctionRegistry(config=tmp_config)
+    reg.create("lmfn", LM_FN)
+    model = reg.load("lmfn")
+    model._set_params(lr=1e-3, batch_size=16, epoch=0, k=1, task="train")
+
+    answers = iter([4, 8, 8])  # 8 devices -> 4 -> back to 8
+
+    def epoch_end(state):
+        return next(answers, state.parallelism)
+
+    req = _spmd_request(epochs=3, options={"mesh_shape": {"tp": 2},
+                                           "static_parallelism": False})
+    job = SPMDJob("spmdel", req, model, store=token_store,
+                  history_store=HistoryStore(config=tmp_config),
+                  checkpoint_store=CheckpointStore(config=tmp_config),
+                  on_epoch_end=epoch_end)
+    hist = job.train()
+    assert hist.parallelism == [8, 4, 8]  # dp 4 -> 2 -> 4 with tp=2 fixed
+    assert all(np.isfinite(l) for l in hist.train_loss)
+    # params survived both host-bounces: the job is still inferable
+    preds = job.infer(token_data(2))
+    assert preds.shape == (2, 16)

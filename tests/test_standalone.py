@@ -99,6 +99,31 @@ def test_standalone_job_end_to_end(standalone_cluster):
     assert "kubeml_job" in text or hist.train_loss  # gauges cleared at finish
 
 
+def test_standalone_per_job_logs_via_cli(standalone_cluster, capsys):
+    """The runner subprocess writes logs/job-<id>.log and `kubeml logs --id`
+    reads it (reference: per-pod `kubectl logs job-<id>`, cmd/log.go:28-66)."""
+    import argparse
+
+    from kubeml_tpu.api.types import TrainOptions, TrainRequest
+    from kubeml_tpu.cli import cmd_logs
+
+    cluster = standalone_cluster
+    req = TrainRequest(
+        function_name="tiny", dataset="blobs", epochs=1, batch_size=16, lr=0.05,
+        options=TrainOptions(default_parallelism=1, static_parallelism=True,
+                             k=2, precision="f32"),
+    )
+    job_id = cluster.scheduler.submit_train(req)
+    assert _wait_done(cluster, job_id)
+
+    log_path = cluster.cfg.data_root / "logs" / f"job-{job_id}.log"
+    assert log_path.exists(), "runner did not write its per-job log"
+    rc = cmd_logs(argparse.Namespace(id=job_id, follow=False))
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "epoch 1/1" in out  # the job's own epoch line, from its own file
+
+
 def test_standalone_job_stop(standalone_cluster):
     cluster = standalone_cluster
     from kubeml_tpu.api.types import TrainOptions, TrainRequest
