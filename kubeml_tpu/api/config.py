@@ -67,6 +67,23 @@ class Config:
     dist_ack_timeout: float = field(
         default_factory=lambda: float(os.environ.get("KUBEML_DIST_ACK_TIMEOUT", "120"))
     )
+    # standalone runners publish per-epoch weights into a socket-served native
+    # TensorStore so the PS serves live /infer without HTTP-JSON round-trips
+    # (KUBEML_TENSOR_SOCKETS=0 disables; auto-off when the native lib is absent)
+    tensor_sockets: bool = field(
+        default_factory=lambda: _env_bool("KUBEML_TENSOR_SOCKETS", True)
+    )
+
+    def job_socket_path(self, job_id: str):
+        """Unix-socket path for a standalone job's tensor server. Lives under
+        the system tmpdir (unix socket paths cap at ~107 bytes — a deep
+        data_root would overflow), namespaced by a digest of the data root so
+        concurrent clusters (e.g. parallel test runs) can't collide."""
+        import hashlib
+        import tempfile
+
+        ns = hashlib.md5(str(self.data_root).encode()).hexdigest()[:8]
+        return Path(tempfile.gettempdir()) / f"kubeml-{ns}-{job_id}.sock"
     # persistent XLA compilation cache: elastic re-meshes recompile per worker
     # count and standalone job runners are fresh processes — both hit this disk
     # cache instead of recompiling (SURVEY §7 "elastic parallelism vs XLA").

@@ -94,7 +94,7 @@ def bench_bert(steps: int = 5) -> dict:
     batch, seq = 32, 128
     sample = r.integers(1, 30000, size=(batch, seq)).astype(np.int32)
     labels = r.integers(0, 2, size=(batch,)).astype(np.int64)
-    return _bench_kavg(BertBase(num_classes=2, max_len=seq, dtype=jnp.bfloat16),
+    return _bench_kavg(BertBase(num_classes=2, dtype=jnp.bfloat16),
                        "bert-base-sst2", sample, labels, k=4, steps_cap=steps)
 
 

@@ -58,6 +58,7 @@ class TrainJob:
         chaos: Optional[FailureInjector] = None,
         health_threshold: int = 3,
         dist=None,
+        on_epoch_weights: Optional[Callable[[dict, int], None]] = None,
     ):
         self.job_id = job_id
         self.request = request
@@ -67,6 +68,10 @@ class TrainJob:
         self._checkpoint_store = checkpoint_store
         self.on_epoch_end = on_epoch_end
         self.on_metrics = on_metrics
+        # per-epoch reference-weights hook (standalone runners publish into
+        # their tensor socket so the PS serves live /infer; one device->host
+        # model copy per epoch — negligible against an epoch of training)
+        self.on_epoch_weights = on_epoch_weights
         self.seed = seed
 
         # multi-controller context: every process runs this same job in
@@ -239,6 +244,15 @@ class TrainJob:
                                        used_parallelism)
                 if opts.checkpoint_every > 0 and (epoch + 1) % opts.checkpoint_every == 0:
                     self._save_checkpoint(epoch)
+                if self.on_epoch_weights is not None and self.dist is None:
+                    try:
+                        self.on_epoch_weights(
+                            self.trainer.reference_variables(self._stacked_vars),
+                            epoch,
+                        )
+                    except Exception:
+                        log.exception("%s: epoch weights publish failed "
+                                      "(non-fatal)", self.job_id)
                 log.info(
                     "%s: epoch %d/%d loss=%.4f acc=%s parallelism=%d %.2fs",
                     self.job_id, epoch + 1, req.epochs, train_loss,

@@ -68,6 +68,12 @@ class JobRunner:
         self.status = "starting"
         self.exit_error: Optional[str] = None
         self.done = threading.Event()
+        # per-epoch reference weights served over the native tensor socket
+        # (the RedisAI-role channel: the PS pulls weights and serves live
+        # /infer locally instead of HTTP-JSON round-tripping payloads here).
+        # Started lazily in _start — only K-AVG jobs publish into it.
+        self._tensor_store = None
+        self._tensor_server = None
         # a FRESH box per epoch-end request: a late answer for epoch N must not
         # satisfy epoch N+1's wait (the PS allocates per-request _UpdateBoxes
         # for the same reason)
@@ -81,6 +87,35 @@ class JobRunner:
         router.route("POST", "/infer", self._infer)
         router.route("GET", "/state", self._state)
         self.service = Service(router, self.cfg.host, port)
+
+    def _start_tensor_server(self) -> None:
+        store = None
+        try:
+            from ..native.bindings import TensorServer, TensorStore
+
+            store = TensorStore()
+            if not store.native:
+                store.close()
+                log.info("native tensor store unavailable; PS will serve live "
+                         "/infer over HTTP")
+                return
+            sock = self.cfg.job_socket_path(self.job_id)
+            sock.unlink(missing_ok=True)
+            self._tensor_server = TensorServer(store, str(sock))
+            self._tensor_store = store
+            log.info("tensor server for %s at %s", self.job_id, sock)
+        except Exception:
+            if store is not None and self._tensor_store is None:
+                store.close()  # don't leak the native handle
+            log.exception("tensor server start failed (non-fatal; HTTP infer "
+                          "fallback remains)")
+
+    def _publish_weights(self, variables: dict, epoch: int) -> None:
+        from ..native.weights import publish_variables
+
+        store = self._tensor_store
+        if store is not None:  # racing shutdown: silently skip
+            publish_variables(store, variables, epoch + 1)
 
     # --- routes ---
 
@@ -103,14 +138,22 @@ class JobRunner:
                 task.state.parallelism or request.options.default_parallelism
             )
             from . import job_class_for
+            from .job import TrainJob
 
-            self.job = job_class_for(request.options)(
+            job_cls = job_class_for(request.options)
+            extra = {}
+            if job_cls is TrainJob and self.cfg.tensor_sockets:
+                self._start_tensor_server()
+            if job_cls is TrainJob and self._tensor_store is not None:
+                extra["on_epoch_weights"] = self._publish_weights
+            self.job = job_cls(
                 self.job_id, request, model,
                 store=ShardStore(config=self.cfg),
                 history_store=HistoryStore(config=self.cfg),
                 checkpoint_store=CheckpointStore(config=self.cfg),
                 on_epoch_end=self._epoch_end,
                 on_metrics=self._push_metrics,
+                **extra,
             )
             self.thread = threading.Thread(target=self._run, name=f"job-{self.job_id}",
                                            daemon=True)
@@ -233,6 +276,24 @@ class JobRunner:
 
     def stop(self) -> None:
         self.service.stop()
+        if self._tensor_store is not None:
+            # the training thread publishes into the store at epoch ends:
+            # freeing the native handle under it would be a use-after-free,
+            # so detach the store reference FIRST (the publisher checks it),
+            # then wait for the thread before freeing
+            store, self._tensor_store = self._tensor_store, None
+            if self.thread is not None and self.thread.is_alive():
+                if self.job is not None:
+                    self.job.stop()
+                self.thread.join(timeout=60.0)
+            if self._tensor_server is not None:
+                self._tensor_server.stop()
+                self._tensor_server = None
+            store.close()
+        try:
+            self.cfg.job_socket_path(self.job_id).unlink(missing_ok=True)
+        except OSError:
+            pass
 
     @property
     def url(self) -> str:

@@ -1,0 +1,85 @@
+"""Model-weight exchange through the native TensorStore.
+
+The reference exchanges weights through RedisAI: workers publish
+``jobId:layer`` tensors and anyone can read the reference model
+(reference: ml/pkg/model/model.go:135-161, python network.py:444-461). The
+TPU-native training path made that hop disappear (the merge is an on-chip
+collective), but STANDALONE job runners still need a cross-process weight
+channel: the PS serves ``/infer`` for a live job whose weights live in another
+process. Round 1 routed that through HTTP-JSON into the runner; this module
+routes it through the native TensorStore's unix socket instead — the PS pulls
+the per-epoch reference weights once per epoch version and serves inference
+locally, no image payloads round-tripping through the runner.
+
+Publish protocol (writer = the job runner, in-process ``TensorStore.set``),
+a seqlock: the version key is set to the NEGATED incoming version before any
+leaf is touched (publish-in-progress sentinel), then leaves, manifest, and
+finally the real version. Readers reject sentinel/absent versions and re-read
+the version after the fetch — a publish racing the fetch always flips the
+version through the sentinel, so a mixed-epoch tree can never be served.
+Tree flattening reuses the checkpoint store's ``a/b/c`` path scheme
+(kubeml_tpu.storage.checkpoint) including its "no '/' in keys" guard.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..storage.checkpoint import _flatten, _unflatten
+
+MANIFEST_KEY = "__manifest__"
+VERSION_KEY = "__version__"
+
+
+def publish_variables(store, variables: dict, version: int) -> None:
+    """Write a (nested-dict) variables tree into ``store``.
+
+    ``version`` must be >= 1 (the seqlock negates it as the in-progress
+    sentinel, and readers treat <= 0 as not-ready)."""
+    if version < 1:
+        raise ValueError(f"version must be >= 1, got {version}")
+    pairs = _flatten(variables)
+    store.set(VERSION_KEY, np.array([-version], np.int64))  # in progress
+    for key, arr in pairs:
+        store.set(key, arr)
+    manifest = json.dumps([k for k, _ in pairs]).encode()
+    store.set(MANIFEST_KEY, np.frombuffer(manifest, np.uint8))
+    store.set(VERSION_KEY, np.array([version], np.int64))
+
+
+def read_version(reader) -> Optional[int]:
+    """The currently published version; None when absent OR mid-publish."""
+    v = reader.get(VERSION_KEY)
+    if v is None:
+        return None
+    version = int(np.asarray(v).reshape(-1)[0])
+    return version if version > 0 else None
+
+
+def fetch_variables(reader, retries: int = 2) -> Tuple[Optional[dict], Optional[int]]:
+    """Read the full tree; returns (variables, version) or (None, None) when
+    nothing is published. Retries when a concurrent publish tears the read
+    (detected by the seqlock version flipping through its sentinel)."""
+    for _ in range(retries + 1):
+        v0 = read_version(reader)
+        if v0 is None:
+            return None, None
+        man = reader.get(MANIFEST_KEY)
+        if man is None:
+            continue
+        keys = json.loads(np.asarray(man).tobytes().decode())
+        leaves: Dict[str, np.ndarray] = {}
+        torn = False
+        for key in keys:
+            arr = reader.get(key)
+            if arr is None:
+                torn = True
+                break
+            leaves[key] = arr
+        if torn or read_version(reader) != v0:
+            continue  # publish raced us; retry
+        return _unflatten(leaves), v0
+    return None, None

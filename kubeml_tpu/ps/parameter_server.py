@@ -87,6 +87,7 @@ class ParameterServer:
         self._jobs: Dict[str, _JobRecord] = {}
         self._monitor: Optional[threading.Thread] = None  # standalone liveness watch
         self._serving_cache: Dict[str, tuple] = {}  # (model, vars, ckpt mtime)
+        self._socket_cache: Dict[str, tuple] = {}  # (model, vars, epoch version)
         self._ckpt_store = CheckpointStore(config=self.cfg)
         self._lock = threading.RLock()
         # multi-host: the PS runs on process 0 and announces each job to the
@@ -172,6 +173,7 @@ class ParameterServer:
                 raise KubeMLError(f"job {task.job_id} already exists", 400)
             self._jobs[task.job_id] = placeholder
             self._serving_cache.pop(task.job_id, None)
+            self._socket_cache.pop(task.job_id, None)
         return placeholder
 
     def _ensure_failure_history(self, job_id: str, request, error: str) -> None:
@@ -460,6 +462,7 @@ class ParameterServer:
             if record is None or (expect is not None and record is not expect):
                 return False
             self._jobs.pop(job_id, None)
+            self._socket_cache.pop(job_id, None)  # socket dies with the runner
         self.metrics.clear(job_id)
         self.metrics.task_finished("train")
         if self.scheduler is not None:
@@ -588,6 +591,18 @@ class ParameterServer:
         if record is None:
             return self._infer_from_checkpoint(model_id, data)
         if record.url is not None:
+            # live standalone job: prefer the runner's tensor socket — the PS
+            # pulls the latest epoch's reference weights once per version and
+            # serves inference locally, so image payloads never round-trip
+            # through the runner (the RedisAI-role channel; VERDICT round 1
+            # gave the native TensorStore this job)
+            try:
+                out = self._infer_from_socket(model_id, record, data)
+                if out is not None:
+                    return out
+            except Exception:
+                log.debug("tensor-socket infer for %s failed; HTTP fallback",
+                          model_id, exc_info=True)
             import requests
 
             from ..api.errors import error_from_envelope
@@ -601,6 +616,42 @@ class ParameterServer:
         self.metrics.task_started("inference")
         try:
             return np.asarray(record.job.infer(np.asarray(data))).tolist()
+        finally:
+            self.metrics.task_finished("inference")
+
+    def _infer_from_socket(self, model_id: str, record, data) -> Optional[list]:
+        """Serve a live standalone job from its runner's tensor socket; None
+        when unavailable (socket off/absent, or no epoch published yet) —
+        the caller then falls back to the runner's HTTP /infer."""
+        import jax.numpy as jnp
+
+        if not self.cfg.tensor_sockets:
+            return None
+        sock = self.cfg.job_socket_path(model_id)
+        if not sock.exists():
+            return None
+        from ..native.bindings import TensorClient
+        from ..native.weights import fetch_variables, read_version
+
+        with self._lock:
+            cached = self._socket_cache.get(model_id)
+        with TensorClient(str(sock), timeout=10) as client:
+            version = read_version(client)
+            if version is None:
+                return None  # first epoch still training; nothing published
+            if cached is None or cached[2] != version:
+                variables, version = fetch_variables(client)
+                if variables is None:
+                    return None
+                model = self.registry.load(record.task.parameters.function_name)
+                cached = (model, variables, version)
+                with self._lock:
+                    self._socket_cache[model_id] = cached
+        model, variables = cached[0], cached[1]
+        self.metrics.task_started("inference")
+        try:
+            x = model.preprocess(jnp.asarray(np.asarray(data)))
+            return np.asarray(model.infer(variables, x)).tolist()
         finally:
             self.metrics.task_finished("inference")
 

@@ -235,3 +235,83 @@ def test_runner_http_surface(tmp_config):
         assert r.status_code == 503
     finally:
         runner.stop()
+
+
+def test_weights_publish_fetch_roundtrip(tmp_path):
+    """publish_variables/fetch_variables through a real socket-served native
+    TensorStore preserve the nested tree exactly (the RedisAI-role channel)."""
+    from kubeml_tpu.native.bindings import TensorClient, TensorServer, TensorStore
+    from kubeml_tpu.native.weights import fetch_variables, publish_variables, read_version
+
+    store = TensorStore()
+    if not store.native:
+        pytest.skip("native tensor store not built")
+    variables = {
+        "params": {
+            "dense": {"kernel": np.arange(12, dtype=np.float32).reshape(3, 4),
+                      "bias": np.zeros(4, np.float32)},
+        },
+        "batch_stats": {"bn": {"mean": np.ones(4, np.float32)}},
+    }
+    sock = str(tmp_path / "w.sock")
+    with store, TensorServer(store, sock):
+        publish_variables(store, variables, version=3)
+        with TensorClient(sock) as client:
+            assert read_version(client) == 3
+            got, v = fetch_variables(client)
+    assert v == 3
+    np.testing.assert_array_equal(got["params"]["dense"]["kernel"],
+                                  variables["params"]["dense"]["kernel"])
+    np.testing.assert_array_equal(got["batch_stats"]["bn"]["mean"],
+                                  variables["batch_stats"]["bn"]["mean"])
+
+
+def test_standalone_live_infer_via_tensor_socket(standalone_cluster):
+    """A LIVE standalone job serves /infer through its tensor socket: the PS
+    pulls per-epoch weights and runs the model locally (no HTTP-JSON payload
+    round-trip through the runner)."""
+    from kubeml_tpu.native.bindings import get_lib
+    if get_lib(block=True) is None:
+        pytest.skip("native tensor store not built")
+
+    cluster = standalone_cluster
+    from kubeml_tpu.api.types import TrainOptions, TrainRequest
+
+    # enough epochs that the job is still alive when the live infer lands
+    # (epochs are ~10ms once compiled; the explicit stop below ends the job)
+    req = TrainRequest(
+        function_name="tiny", dataset="blobs", epochs=100000, batch_size=16,
+        lr=0.05,
+        options=TrainOptions(default_parallelism=2, static_parallelism=True,
+                             k=2, precision="f32", validate_every=0),
+    )
+    job_id = cluster.scheduler.submit_train(req)
+    sock = cluster.cfg.job_socket_path(job_id)
+    # wait for the first epoch's weights to be published while the job runs
+    t0 = time.time()
+    published = False
+    while time.time() - t0 < 120:
+        if sock.exists():
+            from kubeml_tpu.native.bindings import TensorClient
+            from kubeml_tpu.native.weights import read_version
+            try:
+                with TensorClient(str(sock), timeout=5) as c:
+                    if read_version(c) is not None:
+                        published = True
+                        break
+            except (ConnectionError, OSError):
+                pass
+        time.sleep(0.3)
+    assert published, "runner never published epoch weights"
+
+    preds = cluster.ps.infer(job_id, np.zeros((3, 8, 8, 1), np.float32).tolist())
+    assert len(preds) == 3
+    # and it really came through the socket, not the HTTP fallback
+    assert job_id in cluster.ps._socket_cache
+
+    cluster.ps.stop_task(job_id)
+    assert _wait_done(cluster, job_id)
+    # post-finish: socket cache cleared, checkpoint path serves
+    assert job_id not in cluster.ps._socket_cache
+    preds = cluster.ps.infer(job_id, np.zeros((2, 8, 8, 1), np.float32).tolist())
+    assert len(preds) == 2
