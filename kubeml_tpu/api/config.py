@@ -78,12 +78,28 @@ class Config:
         """Unix-socket path for a standalone job's tensor server. Lives under
         the system tmpdir (unix socket paths cap at ~107 bytes — a deep
         data_root would overflow), namespaced by a digest of the data root so
-        concurrent clusters (e.g. parallel test runs) can't collide."""
+        concurrent clusters (e.g. parallel test runs) can't collide.
+
+        The namespace DIRECTORY is created mode 0700 and its ownership is
+        verified — on a multi-user host another user must not be able to
+        pre-bind the predictable socket name and spoof model weights at the
+        PS (native/weights.py carries no authentication by design; the
+        directory permissions are the trust boundary)."""
         import hashlib
+        import os
         import tempfile
 
         ns = hashlib.md5(str(self.data_root).encode()).hexdigest()[:8]
-        return Path(tempfile.gettempdir()) / f"kubeml-{ns}-{job_id}.sock"
+        d = Path(tempfile.gettempdir()) / f"kubeml-{ns}"
+        d.mkdir(mode=0o700, exist_ok=True)
+        st = d.stat()
+        if st.st_uid != os.getuid():
+            raise PermissionError(
+                f"socket directory {d} is owned by uid {st.st_uid}, not us "
+                f"({os.getuid()}); refusing to exchange weights through it"
+            )
+        os.chmod(d, 0o700)  # exist_ok path: enforce even if created looser
+        return d / f"{job_id}.sock"
     # persistent XLA compilation cache: elastic re-meshes recompile per worker
     # count and standalone job runners are fresh processes — both hit this disk
     # cache instead of recompiling (SURVEY §7 "elastic parallelism vs XLA").

@@ -51,6 +51,23 @@ def synth_images(n: int, shape: Tuple[int, ...], classes: int, seed: int):
     return np.clip(x, 0, 255).astype(np.uint8), y
 
 
+def load_digits_real():
+    """The REAL handwritten-digits dataset shipped with scikit-learn (1,797
+    8x8 scans of the UCI optical-digits corpus) — the in-environment real-data
+    convergence target (no network egress here; MNIST/CIFAR arrive via
+    ``scripts/seed_datasets.py mnist|cifar10`` when their files are present).
+    Deterministic 80/20 split (every 5th sample is test). This is THE single
+    definition — ``scripts/seed_datasets.py digits`` seeds exactly this split,
+    so seeded clusters and scenario-created datasets always match."""
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    x = d.images.astype(np.uint8)[..., None]  # [1797, 8, 8, 1], 0..16
+    y = d.target.astype(np.int64)
+    test = np.arange(len(x)) % 5 == 0
+    return x[~test], y[~test], x[test], y[test]
+
+
 def synth_tokens(n: int, seq_len: int, vocab: int, classes: int, seed: int):
     """Learnable text task: class = token-id parity bias of the sequence."""
     r = np.random.default_rng(seed)
@@ -90,6 +107,42 @@ class Model(KubeModel):
     def preprocess(self, x):
         # device-side dequantization: uint8 [0,255] -> bf16 [-1,1]
         return x.astype(jnp.bfloat16) / 127.5 - 1.0
+    def configure_optimizers(self):
+        return optax.sgd(self.lr, momentum=0.9)
+"""
+
+_DIGITS_FN = """
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+from kubeml_tpu.runtime.model import KubeModel
+from kubeml_tpu.data.dataset import KubeDataset
+
+class DigitsNet(nn.Module):
+    # LeNet-style CNN sized for the 8x8 digits scans (LeNet-5 proper needs
+    # >= 14x14 for its 5x5 VALID conv)
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(nn.Conv(32, (3, 3), padding="SAME")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="SAME")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        return nn.Dense(10)(x)
+
+class Ds(KubeDataset):
+    def __init__(self):
+        super().__init__("digits-real")
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(Ds())
+    def build(self):
+        return DigitsNet()
+    def preprocess(self, x):
+        # digits pixels are 0..16 (4-bit scans); scale on device
+        return x.astype(jnp.float32) / 16.0
     def configure_optimizers(self):
         return optax.sgd(self.lr, momentum=0.9)
 """
@@ -184,6 +237,9 @@ def scenarios() -> List[Scenario]:
 
         return make
 
+    def real_digits(quick: bool):
+        return load_digits_real()  # quick == full: the corpus is small
+
     lenet = _IMAGE_FN.format(module="lenet", model="LeNet", dataset="mnist-bench", classes=10)
     resnet = _IMAGE_FN.format(module="resnet", model="ResNet18", dataset="cifar10-bench", classes=10)
     vit = _IMAGE_FN.format(module="vit", model="ViTTiny", dataset="cifar100-bench", classes=100)
@@ -191,6 +247,22 @@ def scenarios() -> List[Scenario]:
     gptlm = _LM_FN.format(dataset="lm-bench", vocab=512, seq_len=32, dim=64, depth=2)
 
     return [
+        # 0: REAL-data convergence target (sklearn handwritten digits) — the
+        # K-AVG convergence science (TTA, K sweeps, accuracy vs global batch)
+        # on real data; reference counterpart: the MNIST/CIFAR experiment
+        # grids (ml/experiments/app/time_to_accuracy.py:40-86)
+        Scenario(
+            "digits-real", _DIGITS_FN, real_digits,
+            request=_req("digits-real", "digits-real", epochs=30, batch_size=32,
+                         lr=0.05,
+                         options=dict(default_parallelism=2, static_parallelism=True,
+                                      k=8, goal_accuracy=95.0, precision="f32")),
+            quick_request=_req("digits-real", "digits-real", epochs=5, batch_size=32,
+                               lr=0.05,
+                               options=dict(default_parallelism=2,
+                                            static_parallelism=True,
+                                            k=4, precision="f32")),
+        ),
         # 1: LeNet/MNIST single function (BASELINE target #1)
         Scenario(
             "lenet-mnist", lenet, images((28, 28, 1), 10, 60000, 10000, 640),
@@ -419,7 +491,8 @@ class ExperimentDriver:
 
 
 def run_all(config: Optional[Config] = None, quick: bool = True,
-            names: Optional[List[str]] = None) -> List[ScenarioResult]:
+            names: Optional[List[str]] = None,
+            max_parallelism: Optional[int] = None) -> List[ScenarioResult]:
     from ..api.config import get_config
 
     cfg = config or get_config()
@@ -430,11 +503,13 @@ def run_all(config: Optional[Config] = None, quick: bool = True,
         if unknown:
             raise ValueError(f"unknown scenario name(s) {unknown}; known: {known}")
     results = []
-    # cap elastic growth in both modes: every new (model, parallelism) pair is
-    # a recompile, and unbounded growth during the concurrent elastic scenario
-    # turns the run into compile churn (measured: full-mode elastic-multijob
-    # timed out on one chip behind the remote-compile tunnel without a cap)
-    with ExperimentDriver(cfg, max_parallelism=4 if quick else 8) as driver:
+    # quick (CI) mode caps elastic growth at 4 to bound compile time; full
+    # mode runs unbounded by default — the engine background-precompiles the
+    # next scale-up level during each epoch (engine/job._precompile_next_level),
+    # which removed the synchronous recompile stall that forced round 1's cap
+    if max_parallelism is None and quick:
+        max_parallelism = 4
+    with ExperimentDriver(cfg, max_parallelism=max_parallelism) as driver:
         for sc in scenarios():
             if names and sc.name not in names:
                 continue
@@ -449,9 +524,13 @@ def main(argv=None) -> int:
     p.add_argument("--quick", action="store_true", help="CI-sized data and epochs")
     p.add_argument("--only", nargs="*", default=None, help="scenario names to run")
     p.add_argument("--out", default=None, help="write results JSON here")
+    p.add_argument("--max-parallelism", type=int, default=None,
+                   help="cap elastic growth (default: unbounded in full mode, "
+                        "4 in --quick)")
     args = p.parse_args(argv)
     try:
-        results = run_all(quick=args.quick, names=args.only)
+        results = run_all(quick=args.quick, names=args.only,
+                          max_parallelism=args.max_parallelism)
     except ValueError as e:
         print(f"error: {e}", file=__import__("sys").stderr)
         return 2

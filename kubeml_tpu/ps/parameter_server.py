@@ -638,8 +638,12 @@ class ParameterServer:
         with TensorClient(str(sock), timeout=10) as client:
             version = read_version(client)
             if version is None:
-                return None  # first epoch still training; nothing published
-            if cached is None or cached[2] != version:
+                # nothing published yet, OR the runner is mid-publish (seqlock
+                # sentinel): serve the previous epoch from cache if we have it
+                # rather than falling back to the HTTP payload round-trip
+                if cached is None:
+                    return None
+            elif cached is None or cached[2] != version:
                 variables, version = fetch_variables(client)
                 if variables is None:
                     return None
