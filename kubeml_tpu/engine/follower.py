@@ -43,7 +43,7 @@ def run_follower(config=None) -> int:
     from ..storage.checkpoint import CheckpointStore
     from ..storage.history import HistoryStore
     from ..storage.store import ShardStore
-    from .job import TrainJob
+    from . import job_class_for
 
     cfg = config or get_config()
     dist = get_dist_context()
@@ -78,7 +78,7 @@ def run_follower(config=None) -> int:
             request.options.default_parallelism = (
                 task.state.parallelism or request.options.default_parallelism
             )
-            job = TrainJob(
+            job = job_class_for(request.options)(
                 task.job_id, request, model,
                 store=store, history_store=history_store,
                 checkpoint_store=ckpt_store,

@@ -48,6 +48,18 @@ from ..utils.tracing import get_tracer
 log = logging.getLogger("kubeml.spmdjob")
 
 
+def spmd_elastic_device_count(new_p: int, n_devices: int, model: int,
+                              size: int = 1) -> int:
+    """Legal device count for an elastic SPMD level: multiples of
+    ``model * size`` so every host contributes equally AND each host's share
+    is a multiple of the model-axis product — dp-major mesh order then keeps
+    every tp/sp/ep group inside one host, so their per-step collectives stay
+    on ICI. (NOT lcm(model, size): lcm(2,2)=2 would let a tp pair straddle
+    hosts and ride DCN every matmul.)"""
+    base = max(1, model) * max(1, size)
+    return max(base, (min(new_p, n_devices) // base) * base)
+
+
 class SPMDJob:
     """Same lifecycle surface as TrainJob (train/stop/state/infer) over the
     SPMD engine."""
@@ -64,10 +76,21 @@ class SPMDJob:
         on_metrics=None,
         devices=None,
         seed: int = 0,
-        dist=None,  # interface parity; the PS rejects multi-host SPMD jobs
+        dist=None,
     ):
-        if dist is not None and getattr(dist, "size", 1) > 1:
-            raise ValueError("SPMDJob does not support multi-host execution")
+        # multi-controller context: every process runs this same job over one
+        # GLOBAL mesh; each host feeds the full batch (XLA takes the local
+        # shards), control decisions are leader-broadcast, and parameter
+        # placement goes through jitted programs (a host cannot device_put
+        # onto chips it does not address). Stop requests take effect at epoch
+        # boundaries in dist mode (a mid-epoch break on one process would
+        # strand the others in a collective).
+        if dist is None and jax.process_count() > 1:
+            from ..parallel.distributed import get_dist_context
+
+            dist = get_dist_context()
+        self.dist = dist
+        self._leader = dist is None or dist.is_leader
         self.job_id = job_id
         self.request = request
         self.model = model
@@ -96,6 +119,8 @@ class SPMDJob:
         # live inference and a donating train step must not touch the same
         # buffers concurrently (donation invalidates the inputs)
         self._step_lock = threading.Lock()
+        # cached jitted identities for dist-mode placement/gather per mesh
+        self._identity_cache: dict = {}
 
     def _make_trainer(self, mesh) -> SPMDTrainer:
         return SPMDTrainer(
@@ -162,15 +187,26 @@ class SPMDJob:
             if opts.resume:
                 start_epoch = self._restore_latest()
 
+            dist_multi = self.dist is not None and self.dist.size > 1
             for epoch in range(start_epoch, req.epochs):
-                if self.stop_event.is_set():
+                stop = self.stop_event.is_set()
+                if dist_multi:
+                    # leader's stop broadcast so no process leaves the
+                    # lockstep loop while others still issue collectives
+                    stop, _ = self.dist.broadcast_flags(stop=stop)
+                    if stop:
+                        self.stop_event.set()
+                if stop:
                     break
                 t0 = time.time()
                 losses = []
                 with self.tracer.span("job.epoch", job=self.job_id, epoch=epoch,
                                       engine="spmd"):
                     for i, batch in enumerate(self._token_batches("train", req.batch_size)):
-                        if self.stop_event.is_set():
+                        if self.stop_event.is_set() and not dist_multi:
+                            # dist mode defers stop to the epoch boundary —
+                            # a one-sided mid-epoch break would strand the
+                            # other processes in a collective
                             break
                         step_rng = jax.random.fold_in(rng, epoch * 100003 + i)
                         with self._step_lock:
@@ -196,8 +232,9 @@ class SPMDJob:
                     validation_loss=val_loss,
                     accuracy=acc_pct,
                 )
-                self._push_metrics(train_loss, val_loss, acc_pct, elapsed,
-                                   used_devices)
+                if self._leader:
+                    self._push_metrics(train_loss, val_loss, acc_pct, elapsed,
+                                       used_devices)
                 log.info("%s: epoch %d/%d loss=%.4f val=%s acc=%s %.2fs",
                          self.job_id, epoch + 1, req.epochs, train_loss,
                          f"{val_loss:.4f}" if val_loss is not None else "-",
@@ -219,20 +256,32 @@ class SPMDJob:
                     break
 
                 # elastic dp re-meshing between epochs (the same scheduler
-                # hook the K-AVG job uses; parallelism = devices in use)
-                if not opts.static_parallelism and self.on_epoch_end is not None:
-                    new_p = self.on_epoch_end(
-                        JobState(parallelism=used_devices, elapsed_time=elapsed)
-                    )
+                # hook the K-AVG job uses; parallelism = devices in use).
+                # The leader asks; the answer is broadcast so every process
+                # re-meshes identically.
+                if not opts.static_parallelism and (
+                    self.on_epoch_end is not None or dist_multi
+                ):
+                    new_p = None
+                    if self._leader and self.on_epoch_end is not None:
+                        new_p = self.on_epoch_end(
+                            JobState(parallelism=used_devices, elapsed_time=elapsed)
+                        )
+                    if dist_multi:
+                        _, p = self.dist.broadcast_flags(parallelism=new_p or 0)
+                        new_p = p or None
                     if new_p:
                         self._maybe_remesh(new_p, rng, first)
 
             if opts.save_model and self.history.train_loss:
-                self.checkpoint_store.save(
-                    self.job_id, self._host_params(),
-                    epoch=len(self.history.train_loss), tag=FINAL_TAG,
-                    meta={"request": req.to_dict(), "history": self._history_lists()},
-                )
+                final = self._host_params()  # collective in dist mode
+                if self._leader:
+                    self.checkpoint_store.save(
+                        self.job_id, final,
+                        epoch=len(self.history.train_loss), tag=FINAL_TAG,
+                        meta={"request": req.to_dict(),
+                              "history": self._history_lists()},
+                    )
         except KubeMLError as e:
             self.exit_error = e.message
             raise
@@ -242,7 +291,8 @@ class SPMDJob:
         finally:
             if self.exit_error is not None and isinstance(self.history.task, dict):
                 self.history.task["error"] = self.exit_error
-            self.history_store.save(self.history)
+            if self._leader:
+                self.history_store.save(self.history)
         return self.history
 
     # --- internals ---
@@ -255,13 +305,28 @@ class SPMDJob:
 
         from .resume import extend_history, select_resume_checkpoint
 
-        best = select_resume_checkpoint(self.checkpoint_store, self.job_id)
-        if best is None:
-            return 0
-        start_epoch, ck = best
+        if self.dist is not None and self.dist.size > 1:
+            # leader selects; every process loads the SAME tag from its own
+            # (shared-filesystem) store — independent selection could diverge
+            # the collective programs (same protocol as the K-AVG job)
+            sel = None
+            if self._leader:
+                best = select_resume_checkpoint(self.checkpoint_store, self.job_id)
+                if best is not None:
+                    sel = {"epoch": best[0], "tag": best[1].tag}
+            sel = self.dist.broadcast_obj(sel)
+            if sel is None:
+                return 0
+            ck = self.checkpoint_store.restore(self.job_id, tag=sel["tag"])
+            start_epoch = int(sel["epoch"])
+        else:
+            best = select_resume_checkpoint(self.checkpoint_store, self.job_id)
+            if best is None:
+                return 0
+            start_epoch, ck = best
         unboxed = meta.unbox(self.trainer.params)
         shardings = jax.tree.map(lambda x: x.sharding, unboxed)
-        placed = jax.device_put(ck.variables, shardings)
+        placed = self._place(ck.variables, shardings)
         self.trainer.params = meta.replace_boxed(self.trainer.params, placed)
         extend_history(self.history, ck)
         log.info("%s: resumed from checkpoint %s (epoch %d)", self.job_id,
@@ -280,6 +345,48 @@ class SPMDJob:
             return None, None
         return float(np.mean(losses)), float(np.mean(accs))
 
+    def _remesh_devices(self, new_p: int):
+        """Pick the device block for an elastic level. Multi-process: every
+        host must contribute equally (a process with no devices in the mesh
+        could not legally join the computation) AND each host's share must be
+        a multiple of the model-axis product — dp-major mesh order then keeps
+        every tp/sp/ep group inside one host, so their per-step collectives
+        stay on ICI (base = model * n_processes, NOT lcm: lcm(2,2)=2 would
+        let a tp pair straddle hosts and ride DCN every matmul)."""
+        model = max(1, int(np.prod(list(self._model_axes.values()))))
+        size = self.dist.size if (self.dist is not None and self.dist.size > 1) else 1
+        devices_new = spmd_elastic_device_count(
+            new_p, len(self._all_devices), model, size
+        )
+        if size == 1:
+            return devices_new, self._all_devices[:devices_new]
+        per = devices_new // size
+        chosen = []
+        for pr in range(size):
+            local = [d for d in self._all_devices if d.process_index == pr]
+            chosen.extend(local[:per])
+        return devices_new, chosen
+
+    def _jit_identity(self, purpose: str, shardings):
+        """Cached jitted identity per (mesh, purpose): a fresh lambda each
+        call would retrace + recompile the placement/gather program on every
+        checkpoint/remesh — the synchronous-compile class round 2 removed."""
+        key = (self.mesh, purpose)
+        fn = self._identity_cache.get(key)
+        if fn is None:
+            fn = jax.jit(lambda v: v, out_shardings=shardings)
+            self._identity_cache[key] = fn
+        return fn
+
+    def _place(self, host_tree, shardings):
+        """Place identical host values onto sharded devices. Multi-process a
+        raw device_put cannot target non-addressable chips — placement runs
+        through a jitted identity with out_shardings instead."""
+        if self.dist is not None and self.dist.size > 1:
+            with jax.set_mesh(self.mesh):
+                return self._jit_identity("place", shardings)(host_tree)
+        return jax.device_put(host_tree, shardings)
+
     def _maybe_remesh(self, new_p: int, rng, sample_batch) -> None:
         """Elastic dp resize between epochs: keep the model axes, change the
         device count. The params host-bounce onto the new mesh (the same
@@ -287,9 +394,9 @@ class SPMDJob:
         optimizer state restarts — consistent with K-AVG's per-sync optimizer
         reset (reference semantics network.py:121-128). The step recompiles
         per mesh shape; the persistent XLA cache makes revisited levels a
-        read."""
+        read. COLLECTIVE in dist mode (host-params gather + jitted placement)."""
         model = max(1, int(np.prod(list(self._model_axes.values()))))
-        devices_new = max(model, (min(new_p, len(self._all_devices)) // model) * model)
+        devices_new, chosen = self._remesh_devices(new_p)
         if devices_new == self.mesh.devices.size:
             return
         dp_new = devices_new // model
@@ -298,7 +405,7 @@ class SPMDJob:
                  self._model_axes or "{}")
         host = self._host_params()
         shape = dict(self._model_axes, dp=dp_new)
-        self.mesh = make_mesh(shape=shape, devices=self._all_devices[:devices_new])
+        self.mesh = make_mesh(shape=shape, devices=chosen)
         self.model.mesh = self.mesh
         with self._step_lock:
             self.trainer = self._make_trainer(self.mesh)
@@ -307,13 +414,23 @@ class SPMDJob:
 
             unboxed = meta.unbox(self.trainer.params)
             shardings = jax.tree.map(lambda x: x.sharding, unboxed)
-            placed = jax.device_put(host, shardings)
+            placed = self._place(host, shardings)
             self.trainer.params = meta.replace_boxed(self.trainer.params, placed)
 
     def _host_params(self):
+        """Host copy of the params. COLLECTIVE in dist mode: every process
+        must call it at the same point (replicated gather through jit — a
+        host fetch of a non-fully-addressable array would hang)."""
         import flax.linen as nn
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
-        return jax.tree.map(np.asarray, nn.meta.unbox(self.trainer.params))
+        unboxed = nn.meta.unbox(self.trainer.params)
+        if self.dist is not None and self.dist.size > 1:
+            replicated = NamedSharding(self.mesh, P())
+            rep_shardings = jax.tree.map(lambda _: replicated, unboxed)
+            with jax.set_mesh(self.mesh):
+                unboxed = self._jit_identity("gather", rep_shardings)(unboxed)
+        return jax.tree.map(np.asarray, unboxed)
 
     def _history_lists(self) -> dict:
         h = self.history
@@ -328,8 +445,11 @@ class SPMDJob:
     def _save_checkpoint(self, epoch: int) -> None:
         try:
             with self.tracer.span("job.checkpoint", job=self.job_id, epoch=epoch):
+                variables = self._host_params()  # collective in dist mode
+                if not self._leader:
+                    return
                 self.checkpoint_store.save(
-                    self.job_id, self._host_params(), epoch=epoch,
+                    self.job_id, variables, epoch=epoch,
                     meta={"request": self.request.to_dict(),
                           "history": self._history_lists()},
                 )
@@ -357,6 +477,13 @@ class SPMDJob:
         """Greedy next-token ids for each position of the given token batch."""
         if self.trainer.params is None:
             raise KubeMLError(f"job {self.job_id} has no model yet", 400)
+        if self.dist is not None and self.dist.size > 1:
+            # serving mid-training would need a collective the followers are
+            # not at; the finished model serves from the final checkpoint
+            raise KubeMLError(
+                f"job {self.job_id} is training multi-host; inference is "
+                f"served from its checkpoint after it finishes", 409
+            )
         import jax.numpy as jnp
 
         with self._step_lock, jax.set_mesh(self.mesh):

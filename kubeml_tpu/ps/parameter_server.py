@@ -123,11 +123,6 @@ class ParameterServer:
             self._start_standalone(task)
             return
         req = task.parameters
-        if dist is not None and req.options.engine == "spmd":
-            raise KubeMLError(
-                "the SPMD engine does not run multi-host yet; use the K-AVG "
-                "engine (default) for multi-host jobs", 400
-            )
         placeholder = self._reserve_slot(task)
         try:
             model = self.registry.load(req.function_name)

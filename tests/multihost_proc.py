@@ -19,6 +19,62 @@ import os
 import sys
 
 
+class _Done(Exception):
+    """Mode handled; skip the default K-AVG flow (cleanup still runs)."""
+
+
+def _run_spmd_job(cluster, result) -> None:
+    """One --engine spmd LM job (tp=2) spanning both processes' devices."""
+    import numpy as np
+
+    from kubeml_tpu.api.types import JobState, TrainOptions, TrainRequest, TrainTask
+
+    src = (
+        "import optax\n"
+        "from kubeml_tpu.data.dataset import KubeDataset\n"
+        "from kubeml_tpu.models.gpt import CausalTransformer\n"
+        "from kubeml_tpu.runtime.model import KubeModel\n"
+        "class DS(KubeDataset):\n"
+        "    def __init__(self):\n"
+        "        super().__init__('tokens')\n"
+        "class Model(KubeModel):\n"
+        "    def __init__(self):\n"
+        "        super().__init__(DS())\n"
+        "    def build(self):\n"
+        "        return CausalTransformer(vocab_size=64, max_len=16,\n"
+        "                                 embed_dim=32, depth=2, num_heads=4,\n"
+        "                                 mesh=self.mesh)\n"
+        "    def configure_optimizers(self):\n"
+        "        return optax.adamw(self.lr)\n"
+        "def main():\n"
+        "    return Model()\n"
+    )
+    cluster.registry.create("mhlm", src)
+    r = np.random.default_rng(0)
+    xtr = r.integers(1, 64, size=(256, 16)).astype(np.int32)
+    cluster.store.create("tokens", xtr, np.zeros(256, np.int64),
+                         xtr[:64], np.zeros(64, np.int64))
+    req = TrainRequest(
+        dataset="tokens", function_name="mhlm", epochs=2, batch_size=16,
+        lr=1e-3,
+        options=TrainOptions(engine="spmd", precision="f32", validate_every=1,
+                             mesh_shape={"tp": 2}, static_parallelism=True),
+    )
+    task = TrainTask(job_id="mhspmd01", parameters=req, state=JobState())
+    cluster.ps.start_task(task)
+    cluster.ps.wait(task.job_id, timeout=600)
+    hist = cluster.history_store.get(task.job_id)
+    error = hist.task.get("error") if isinstance(hist.task, dict) else None
+    result.update(
+        status=str(task.status),
+        epochs=len(hist.train_loss),
+        train_loss=hist.train_loss,
+        accuracy=hist.accuracy,
+        parallelism=hist.parallelism,
+        error=error,
+    )
+
+
 def main() -> int:
     rank = int(sys.argv[1])
     nprocs = int(sys.argv[2])
@@ -26,7 +82,8 @@ def main() -> int:
     workdir = sys.argv[4]
     # "shared" = both processes see one data root (normal deployment);
     # "split" = the follower has its own EMPTY root, so it cannot construct
-    # the job — the start handshake must abort the job cleanly on the leader
+    # the job — the start handshake must abort the job cleanly on the leader;
+    # "spmd" = shared root, one --engine spmd job (tp=2 across both processes)
     mode = sys.argv[5] if len(sys.argv) > 5 else "shared"
     out_path = os.path.join(workdir, f"result_{rank}.json")
 
@@ -51,7 +108,7 @@ def main() -> int:
 
     from kubeml_tpu.api.config import Config, set_config
 
-    root = "data" if (rank == 0 or mode == "shared") else f"data_f{rank}"
+    root = "data" if (rank == 0 or mode != "split") else f"data_f{rank}"
     cfg = Config(data_root=Path(workdir) / root)
     set_config(cfg)
 
@@ -68,6 +125,9 @@ def main() -> int:
         cluster = LocalCluster(config=cfg, serve_http=False)
         cluster.start()
         try:
+            if mode == "spmd":
+                _run_spmd_job(cluster, result)
+                raise _Done
             # deploy the function + synthetic dataset (both hosts read the
             # same data root, as a shared filesystem would provide)
             src = (
@@ -119,6 +179,8 @@ def main() -> int:
                 parallelism=hist.parallelism,
                 error=error,
             )
+        except _Done:
+            pass
         finally:
             print("T: stopping cluster", flush=True)
             cluster.stop()

@@ -144,6 +144,20 @@ def test_two_process_training_job(tmp_path):
     assert r1["jobs_followed"] == 1
 
 
+def test_two_process_spmd_job(tmp_path):
+    """An --engine spmd job (tp=2) spanning two jax.distributed processes:
+    tensor-parallel matmul collectives cross the process boundary every step,
+    and validation/accuracy/final export work leader-side."""
+    r0, r1 = _run_pair(tmp_path, "spmd")
+    assert r0["global_devices"] == 4
+    assert "finished" in r0["status"].lower(), r0.get("error")
+    assert r0["epochs"] == 2
+    assert all(np.isfinite(v) for v in r0["train_loss"])
+    assert r0["parallelism"] == [4, 4]  # the whole global mesh, both epochs
+    assert r0["accuracy"] and all(0 <= a <= 100 for a in r0["accuracy"])
+    assert r1["jobs_followed"] == 1
+
+
 def test_two_process_follower_start_failure_aborts_cleanly(tmp_path):
     """A follower that cannot construct the job (function not replicated to
     its host) must abort the job through the start handshake — a clean FAILED
@@ -153,3 +167,23 @@ def test_two_process_follower_start_failure_aborts_cleanly(tmp_path):
     assert "could not start" in (r0.get("error") or "")
     assert r0["epochs"] == 0
     assert r1["jobs_followed"] == 0
+
+
+def test_spmd_elastic_device_count_keeps_model_groups_on_one_host():
+    from kubeml_tpu.engine.spmd_job import spmd_elastic_device_count
+
+    # the lcm trap: 2 hosts, tp=2, scheduler asks for 6 devices — 6/host=3
+    # would straddle a tp pair across hosts; the legal answer is 4
+    assert spmd_elastic_device_count(6, 8, model=2, size=2) == 4
+    assert spmd_elastic_device_count(8, 8, model=2, size=2) == 8
+    assert spmd_elastic_device_count(1, 8, model=2, size=2) == 4  # floor
+    # single host: multiples of the model product only
+    assert spmd_elastic_device_count(6, 8, model=2, size=1) == 6
+    assert spmd_elastic_device_count(3, 8, model=2, size=1) == 2
+    # every result divides into equal per-host shares that model divides
+    for model in (1, 2, 4):
+        for size in (1, 2, 4):
+            for p in range(1, 17):
+                d = spmd_elastic_device_count(p, 16, model, size)
+                assert d % size == 0
+                assert (d // size) % model == 0
