@@ -359,13 +359,13 @@ class SPMDJob:
             new_p, len(self._all_devices), model, size
         )
         if size == 1:
-            return devices_new, self._all_devices[:devices_new]
+            return devices_new, self._all_devices[:devices_new], model
         per = devices_new // size
         chosen = []
         for pr in range(size):
             local = [d for d in self._all_devices if d.process_index == pr]
             chosen.extend(local[:per])
-        return devices_new, chosen
+        return devices_new, chosen, model
 
     def _jit_identity(self, purpose: str, shardings):
         """Cached jitted identity per (mesh, purpose): a fresh lambda each
@@ -395,8 +395,7 @@ class SPMDJob:
         reset (reference semantics network.py:121-128). The step recompiles
         per mesh shape; the persistent XLA cache makes revisited levels a
         read. COLLECTIVE in dist mode (host-params gather + jitted placement)."""
-        model = max(1, int(np.prod(list(self._model_axes.values()))))
-        devices_new, chosen = self._remesh_devices(new_p)
+        devices_new, chosen, model = self._remesh_devices(new_p)
         if devices_new == self.mesh.devices.size:
             return
         dp_new = devices_new // model
@@ -443,11 +442,16 @@ class SPMDJob:
         }
 
     def _save_checkpoint(self, epoch: int) -> None:
-        try:
-            with self.tracer.span("job.checkpoint", job=self.job_id, epoch=epoch):
-                variables = self._host_params()  # collective in dist mode
-                if not self._leader:
-                    return
+        # the gather is COLLECTIVE in dist mode and must stay OUTSIDE the
+        # non-fatal guard: swallowing a one-sided fault here would let this
+        # process run ahead while its peers sit in the gather — the hang the
+        # follower's failure semantics exist to prevent. Only the disk write
+        # is non-fatal.
+        with self.tracer.span("job.checkpoint", job=self.job_id, epoch=epoch):
+            variables = self._host_params()
+            if not self._leader:
+                return
+            try:
                 self.checkpoint_store.save(
                     self.job_id, variables, epoch=epoch,
                     meta={"request": self.request.to_dict(),
@@ -456,8 +460,8 @@ class SPMDJob:
                 self.checkpoint_store.prune_epochs(
                     self.job_id, self.request.options.checkpoint_keep
                 )
-        except Exception:
-            log.exception("%s: checkpoint save failed (non-fatal)", self.job_id)
+            except Exception:
+                log.exception("%s: checkpoint save failed (non-fatal)", self.job_id)
 
     def _push_metrics(self, train_loss, val_loss, acc_pct, elapsed, parallelism) -> None:
         if self.on_metrics is None:

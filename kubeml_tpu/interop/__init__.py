@@ -1,6 +1,8 @@
 from .torch_import import (  # noqa: F401
     conv_kernel_from_torch,
     export_hf_bert,
+    export_hf_gpt2,
     import_hf_bert,
+    import_hf_gpt2,
     linear_kernel_from_torch,
 )

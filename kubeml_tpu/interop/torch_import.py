@@ -193,3 +193,126 @@ def export_hf_bert(variables: Mapping[str, Any], model) -> Dict[str, np.ndarray]
     put("bert.pooler.dense", lin(p["pooler"]))
     put("classifier", lin(p["Dense_0"]))
     return out
+
+
+def import_hf_gpt2(state_dict: Mapping[str, Any], model) -> Dict[str, Any]:
+    """Map a HuggingFace ``GPT2LMHeadModel`` state_dict onto a
+    :class:`~kubeml_tpu.models.gpt.CausalTransformer`'s variables.
+
+    ``model`` must be built GPT-2-compatible: ``attn_bias=True, ln_eps=1e-5``
+    and matching vocab/max_len/embed_dim/depth/num_heads —
+    ``GPTSmall(vocab_size=50257, max_len=1024, attn_bias=True, ln_eps=1e-5)``
+    covers gpt2-124M. Returns ``{"params": ...}`` shaped like
+    ``model.init``'s.
+
+    Mapping notes:
+    * HF GPT-2 ``Conv1D`` weights are ALREADY ``[in, out]`` (not torch
+      ``Linear``'s ``[out, in]``), so kernels pass through untransposed;
+      ``c_attn`` fuses q/k/v along the output axis and is split in thirds.
+    * The LM head is weight-tied to ``wte`` upstream; here it becomes an
+      untied ``lm_head.kernel = wte.T`` (logits-identical at import time).
+    * This model reserves token id 0 as padding (attention-masked); GPT-2
+      has no pad id, so supply inputs without id 0 for exact parity.
+    * gelu matches (HF ``gelu_new`` == flax tanh-approximate gelu).
+    """
+    sd = {k.removeprefix("transformer."): v for k, v in dict(state_dict).items()}
+    E = model.embed_dim
+    if getattr(model, "attn_bias", False) is not True or model.ln_eps != 1e-5:
+        raise ValueError(
+            "target CausalTransformer must be built with attn_bias=True, "
+            "ln_eps=1e-5 for GPT-2 parity"
+        )
+
+    wte = _np(sd["wte.weight"])  # [V, E]
+    wpe = _np(sd["wpe.weight"])  # [P, E]
+    if wte.shape != (model.vocab_size, E):
+        raise ValueError(
+            f"checkpoint vocab/embed {wte.shape} != model "
+            f"({model.vocab_size}, {E})"
+        )
+    if wpe.shape[0] < model.max_len:
+        raise ValueError(
+            f"checkpoint max positions {wpe.shape[0]} < model.max_len "
+            f"{model.max_len}"
+        )
+    n_layers = 1 + max(
+        (int(k.split(".")[1]) for k in sd if k.startswith("h.")), default=-1
+    )
+    if n_layers != model.depth:
+        raise ValueError(
+            f"checkpoint has {n_layers} layers but model.depth is "
+            f"{model.depth} — a silent truncation would produce garbage logits"
+        )
+
+    params: Dict[str, Any] = {
+        "token_embed": {"embedding": wte},
+        "pos_embed": wpe[: model.max_len][None],  # [1, L, E]
+        "ln_f": _layer_norm(sd, "ln_f"),
+        "lm_head": {"kernel": wte.T.copy()},  # untied from the tied HF head
+    }
+    for i in range(model.depth):
+        hf = f"h.{i}"
+        ca = _np(sd[f"{hf}.attn.c_attn.weight"])  # Conv1D: [E, 3E]
+        cab = _np(sd[f"{hf}.attn.c_attn.bias"])   # [3E]
+        qw, kw, vw = np.split(ca, 3, axis=1)
+        qb, kb, vb = np.split(cab, 3)
+        params[f"block_{i}"] = {
+            "ln1": _layer_norm(sd, f"{hf}.ln_1"),
+            "ln2": _layer_norm(sd, f"{hf}.ln_2"),
+            "attn": {
+                "query": {"kernel": qw, "bias": qb},
+                "key": {"kernel": kw, "bias": kb},
+                "value": {"kernel": vw, "bias": vb},
+                "proj": {"kernel": _np(sd[f"{hf}.attn.c_proj.weight"]),
+                         "bias": _np(sd[f"{hf}.attn.c_proj.bias"])},
+            },
+            "mlp_in": {"kernel": _np(sd[f"{hf}.mlp.c_fc.weight"]),
+                       "bias": _np(sd[f"{hf}.mlp.c_fc.bias"])},
+            "mlp_out": {"kernel": _np(sd[f"{hf}.mlp.c_proj.weight"]),
+                        "bias": _np(sd[f"{hf}.mlp.c_proj.bias"])},
+        }
+    return {"params": params}
+
+
+def export_hf_gpt2(variables: Mapping[str, Any], model) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`import_hf_gpt2`: a CausalTransformer variables pytree
+    → a ``GPT2LMHeadModel``-shaped state_dict of numpy arrays (Conv1D layout,
+    q/k/v re-fused; ``lm_head.weight`` exported from ``wte`` per HF tying —
+    a fine-tuned untied lm_head would diverge and is exported as the tied
+    embedding, matching how HF loads gpt2 checkpoints)."""
+    p = variables["params"]
+
+    def ln(d):
+        return {"weight": np.asarray(d["scale"]).copy(),
+                "bias": np.asarray(d["bias"]).copy()}
+
+    out: Dict[str, np.ndarray] = {}
+
+    def put(prefix, d):
+        for k, v in d.items():
+            out[f"{prefix}.{k}"] = v
+
+    wte = np.asarray(p["token_embed"]["embedding"]).copy()
+    out["transformer.wte.weight"] = wte
+    out["transformer.wpe.weight"] = np.asarray(p["pos_embed"])[0].copy()
+    put("transformer.ln_f", ln(p["ln_f"]))
+    out["lm_head.weight"] = wte.copy()
+
+    for i in range(model.depth):
+        blk = p[f"block_{i}"]
+        hf = f"transformer.h.{i}"
+        put(f"{hf}.ln_1", ln(blk["ln1"]))
+        put(f"{hf}.ln_2", ln(blk["ln2"]))
+        attn = blk["attn"]
+        out[f"{hf}.attn.c_attn.weight"] = np.concatenate(
+            [np.asarray(attn[n]["kernel"]) for n in ("query", "key", "value")],
+            axis=1).copy()
+        out[f"{hf}.attn.c_attn.bias"] = np.concatenate(
+            [np.asarray(attn[n]["bias"]) for n in ("query", "key", "value")]).copy()
+        out[f"{hf}.attn.c_proj.weight"] = np.asarray(attn["proj"]["kernel"]).copy()
+        out[f"{hf}.attn.c_proj.bias"] = np.asarray(attn["proj"]["bias"]).copy()
+        out[f"{hf}.mlp.c_fc.weight"] = np.asarray(blk["mlp_in"]["kernel"]).copy()
+        out[f"{hf}.mlp.c_fc.bias"] = np.asarray(blk["mlp_in"]["bias"]).copy()
+        out[f"{hf}.mlp.c_proj.weight"] = np.asarray(blk["mlp_out"]["kernel"]).copy()
+        out[f"{hf}.mlp.c_proj.bias"] = np.asarray(blk["mlp_out"]["bias"]).copy()
+    return out

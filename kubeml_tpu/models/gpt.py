@@ -36,6 +36,7 @@ class CausalSelfAttention(nn.Module):
     num_heads: int
     mesh: Optional[Mesh] = None
     dtype: Any = jnp.float32
+    use_bias: bool = False  # GPT-2-family checkpoints carry qkv/proj biases
     # sequence-parallel scheme when mesh.sp > 1: "ring" (ppermute K/V rotation,
     # kubeml_tpu.parallel.ring) or "ulysses" (head<->sequence all_to_all,
     # kubeml_tpu.parallel.ulysses — needs the per-tp-shard head count,
@@ -56,7 +57,7 @@ class CausalSelfAttention(nn.Module):
         dense = lambda feats, names, name: nn.Dense(
             feats, name=name,
             kernel_init=_part(names)(nn.initializers.lecun_normal()),
-            use_bias=False, dtype=self.dtype,
+            use_bias=self.use_bias, dtype=self.dtype,
         )
         heads = lambda t: t.reshape(B, L, H, D)
         q = heads(dense(H * D, (None, "tp"), "query")(x))
@@ -100,16 +101,21 @@ class GPTBlock(nn.Module):
     mesh: Optional[Mesh] = None
     sp_impl: str = "ring"
     dtype: Any = jnp.float32
+    ln_eps: float = 1e-6    # GPT-2 checkpoints use 1e-5
+    attn_bias: bool = False
 
     @nn.compact
     def __call__(self, x, valid, train: bool = False):
-        y = nn.LayerNorm(name="ln1", dtype=jnp.float32)(x).astype(self.dtype)
+        y = nn.LayerNorm(name="ln1", dtype=jnp.float32,
+                         epsilon=self.ln_eps)(x).astype(self.dtype)
         y = CausalSelfAttention(self.num_heads, mesh=self.mesh,
                                 sp_impl=self.sp_impl, dtype=self.dtype,
+                                use_bias=self.attn_bias,
                                 name="attn")(y, valid)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
-        y = nn.LayerNorm(name="ln2", dtype=jnp.float32)(x).astype(self.dtype)
+        y = nn.LayerNorm(name="ln2", dtype=jnp.float32,
+                         epsilon=self.ln_eps)(x).astype(self.dtype)
         E = x.shape[-1]
         y = nn.Dense(E * self.mlp_ratio, name="mlp_in", dtype=self.dtype,
                      kernel_init=_part((None, "tp"))(nn.initializers.lecun_normal()),
@@ -143,6 +149,9 @@ class CausalTransformer(nn.Module):
     # HBM lever. MoE blocks are left unrematerialized (their sown aux-loss
     # collection does not thread through nn.remat).
     remat: bool = False
+    # --- HF GPT-2 compatibility (kubeml_tpu.interop.import_hf_gpt2) ---
+    ln_eps: float = 1e-6    # GPT-2 uses 1e-5
+    attn_bias: bool = False
     # --- MoE interleaving ---
     moe_every: int = 0
     num_experts: int = 8
@@ -175,8 +184,11 @@ class CausalTransformer(nn.Module):
                 )
                 x = block_cls(self.num_heads, self.mlp_ratio, self.dropout,
                               mesh=self.mesh, sp_impl=self.sp_impl,
-                              dtype=self.dtype, name=f"block_{i}")(x, valid, train)
-        x = nn.LayerNorm(name="ln_f", dtype=jnp.float32)(x).astype(self.dtype)
+                              dtype=self.dtype, ln_eps=self.ln_eps,
+                              attn_bias=self.attn_bias,
+                              name=f"block_{i}")(x, valid, train)
+        x = nn.LayerNorm(name="ln_f", dtype=jnp.float32,
+                         epsilon=self.ln_eps)(x).astype(self.dtype)
         logits = nn.Dense(self.vocab_size, name="lm_head", use_bias=False,
                           dtype=self.dtype,
                           kernel_init=_part((None, "tp"))(nn.initializers.lecun_normal()))(x)
@@ -191,7 +203,11 @@ def GPTTiny(vocab_size: int = 1000, max_len: int = 128, mesh=None,
 
 
 def GPTSmall(vocab_size: int = 32000, max_len: int = 2048, mesh=None,
-             dtype: Any = jnp.float32) -> CausalTransformer:
-    """GPT-2-small-ish (124M)."""
+             dtype: Any = jnp.float32, attn_bias: bool = False,
+             ln_eps: float = 1e-6) -> CausalTransformer:
+    """GPT-2-small-ish (124M). For importing an HF gpt2 checkpoint pass
+    ``vocab_size=50257, max_len=1024, attn_bias=True, ln_eps=1e-5``
+    (kubeml_tpu.interop.import_hf_gpt2)."""
     return CausalTransformer(vocab_size=vocab_size, max_len=max_len, embed_dim=768,
-                             depth=12, num_heads=12, mesh=mesh, dtype=dtype)
+                             depth=12, num_heads=12, mesh=mesh, dtype=dtype,
+                             attn_bias=attn_bias, ln_eps=ln_eps)

@@ -147,3 +147,75 @@ class TestHFBertExport:
                     "bert.encoder.layer.1.output.dense.bias",
                     "bert.pooler.dense.weight", "classifier.weight"):
             np.testing.assert_allclose(exported[key], sd[key].numpy(), atol=1e-6)
+
+
+class TestGPT2Import:
+    @pytest.fixture(scope="class")
+    def pair_gpt2(self):
+        """Random-initialized tiny HF GPT2LMHeadModel + matching CausalTransformer."""
+        from transformers import GPT2Config, GPT2LMHeadModel
+
+        from kubeml_tpu.interop import import_hf_gpt2
+        from kubeml_tpu.models.gpt import CausalTransformer
+
+        torch.manual_seed(0)
+        cfg = GPT2Config(vocab_size=97, n_positions=32, n_embd=48, n_layer=2,
+                         n_head=4)
+        hf = GPT2LMHeadModel(cfg).eval()
+        model = CausalTransformer(vocab_size=97, max_len=32, embed_dim=48,
+                                  depth=2, num_heads=4, attn_bias=True,
+                                  ln_eps=1e-5)
+        variables = import_hf_gpt2(hf.state_dict(), model)
+        return hf, model, variables
+
+    def test_logits_match_torch(self, pair_gpt2):
+        import jax.numpy as jnp
+
+        hf, model, variables = pair_gpt2
+        # ids avoid 0: this model reserves 0 as attention-masked padding
+        ids = np.random.default_rng(0).integers(1, 97, size=(2, 16))
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(ids)).logits.numpy()
+        ours = np.asarray(model.apply(variables, jnp.asarray(ids), train=False))
+        np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+    def test_tree_matches_init_shapes(self, pair_gpt2):
+        import jax
+
+        hf, model, variables = pair_gpt2
+        init = model.init(jax.random.PRNGKey(0),
+                          np.ones((1, 8), np.int32), train=False)
+        import flax.linen as nn
+
+        ref_shapes = jax.tree.map(lambda x: x.shape, nn.meta.unbox(init))
+        got_shapes = jax.tree.map(lambda x: x.shape, variables)
+        assert ref_shapes == got_shapes
+
+    def test_roundtrip_import_export(self, pair_gpt2):
+        from kubeml_tpu.interop import export_hf_gpt2
+
+        hf, model, variables = pair_gpt2
+        sd = export_hf_gpt2(variables, model)
+        # drop ONLY the causal-mask buffers (".attn.bias"/".attn.masked_bias");
+        # the fused qkv bias "c_attn.bias" must stay in the comparison
+        ref = {k: v.detach().numpy() for k, v in hf.state_dict().items()
+               if not k.endswith(".attn.bias")
+               and not k.endswith(".attn.masked_bias")}
+        for k, v in ref.items():
+            np.testing.assert_allclose(sd[k], v, atol=1e-6, err_msg=k)
+
+    def test_wrong_config_rejected(self, pair_gpt2):
+        from kubeml_tpu.interop import import_hf_gpt2
+        from kubeml_tpu.models.gpt import CausalTransformer
+
+        with pytest.raises(ValueError):
+            import_hf_gpt2({}, CausalTransformer())  # missing the parity knobs
+        hf, model, _ = pair_gpt2
+        with pytest.raises(ValueError, match="layers"):
+            # depth mismatch must be loud, not a silent truncation
+            import_hf_gpt2(
+                hf.state_dict(),
+                CausalTransformer(vocab_size=97, max_len=32, embed_dim=48,
+                                  depth=1, num_heads=4, attn_bias=True,
+                                  ln_eps=1e-5),
+            )
