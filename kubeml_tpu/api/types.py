@@ -114,6 +114,8 @@ class TrainOptions(_JsonMixin):
     chaos_prob: float = 0.0  # per-worker per-round failure probability
 
     def __post_init__(self):
+        if self.goal_loss < 0.0:
+            raise ValueError(f"goal_loss must be >= 0 (0 = off), got {self.goal_loss}")
         if self.engine not in ("kavg", "spmd"):
             raise ValueError(f"engine must be 'kavg' or 'spmd', got {self.engine!r}")
         if self.validate_every < 0:

@@ -44,6 +44,11 @@ def cmd_train(args) -> int:
         print("error: --resume requires --id (the job id whose checkpoints to continue)",
               file=sys.stderr)
         return 1
+    if args.goal_loss and args.engine != "spmd":
+        print("error: --goal-loss is an SPMD-engine goal (eval loss); "
+              "use --goal-accuracy for K-AVG jobs or add --engine spmd",
+              file=sys.stderr)
+        return 1
     k = -1 if args.sparse_avg else args.k
     mesh_shape = None
     if args.mesh:
@@ -69,6 +74,7 @@ def cmd_train(args) -> int:
             k=k,
             validate_every=args.validate_every,
             goal_accuracy=args.goal_accuracy,
+            goal_loss=args.goal_loss,
             checkpoint_every=args.checkpoint_every,
             resume=args.resume,
             save_model=not args.no_save_model,
@@ -300,6 +306,9 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--sparse-avg", action="store_true", help="one sync per epoch (K=-1)")
     t.add_argument("--validate-every", type=int, default=1)
     t.add_argument("--goal-accuracy", type=float, default=100.0)
+    t.add_argument("--goal-loss", type=float, default=0.0,
+                   help="SPMD: early-stop when eval loss <= this "
+                        "(perplexity target P -> ln P); 0 = off")
     t.add_argument("--checkpoint-every", type=int, default=0,
                    help="save a checkpoint every N epochs (0 = off)")
     t.add_argument("--id", default=None,

@@ -102,3 +102,27 @@ def test_cli_full_flow(cluster, tmp_path, capsys):
 def test_cli_batch_validation(cluster):
     assert main(["--url", cluster.controller_url, "train", "-f", "x", "-d", "y",
                  "-b", "2048"]) == 1
+
+
+def test_cli_goal_loss_threads_to_request(monkeypatch):
+    """--goal-loss lands in TrainOptions (the SPMD perplexity goal)."""
+    captured = {}
+
+    class FakeNetworks:
+        def train(self, req):
+            captured["req"] = req
+            return "abcd1234"
+
+    class FakeClient:
+        def __init__(self, url=None):
+            pass
+
+        def networks(self):
+            return FakeNetworks()
+
+    monkeypatch.setattr("kubeml_tpu.controller.client.KubemlClient", FakeClient)
+    assert main(["--url", "http://x", "train", "-f", "fn", "-d", "ds",
+                 "--engine", "spmd", "--goal-loss", "3.2"]) == 0
+    req = captured["req"]
+    assert req.options.goal_loss == 3.2
+    assert req.options.engine == "spmd"
