@@ -44,6 +44,9 @@ def cmd_train(args) -> int:
         print("error: --resume requires --id (the job id whose checkpoints to continue)",
               file=sys.stderr)
         return 1
+    if args.goal_loss < 0:
+        print("error: --goal-loss must be >= 0 (0 = off)", file=sys.stderr)
+        return 1
     if args.goal_loss and args.engine != "spmd":
         print("error: --goal-loss is an SPMD-engine goal (eval loss); "
               "use --goal-accuracy for K-AVG jobs or add --engine spmd",

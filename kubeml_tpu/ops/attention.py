@@ -38,6 +38,15 @@ import jax.numpy as jnp
 # threshold get the kernel; None disables.
 FLASH_MIN_KV_LEN = 4096
 
+# Upper auto-dispatch bound: the kernels keep each (batch, head)'s whole
+# padded K/V resident in VMEM (grid walks q-blocks only), which stops
+# compiling between L=8192 (measured good) and L=16384 (measured: remote
+# compile fails) on v5e. Above this, auto-dispatch falls back to XLA's
+# fused+remat path (measured 17.9k tokens/sec at L=16k) rather than crashing
+# mid-compile; a K/V-streaming grid (k-blocks as a sequential grid axis) is
+# the known fix and would lift the cap. None disables the bound.
+FLASH_MAX_KV_LEN = 8192
+
 
 def dot_product_attention(
     q: jnp.ndarray,  # [B, Lq, H, D]
@@ -61,6 +70,7 @@ def dot_product_attention(
             and mask is None
             and jax.default_backend() == "tpu"
             and k.shape[1] >= FLASH_MIN_KV_LEN
+            and (FLASH_MAX_KV_LEN is None or k.shape[1] <= FLASH_MAX_KV_LEN)
             else "xla"
         )
     if impl == "pallas":

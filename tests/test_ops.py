@@ -204,3 +204,34 @@ def test_structured_mask_xla_path_equivalence(rng):
         rtol=1e-6,
         atol=1e-6,
     )
+
+
+def test_dispatch_caps_at_max_kv_len(rng, monkeypatch):
+    """Auto-dispatch must fall back to XLA above FLASH_MAX_KV_LEN (the
+    measured compile ceiling of the VMEM-resident-KV kernel) instead of
+    handing Mosaic a program that fails to compile."""
+    import kubeml_tpu.ops.attention as att
+
+    calls = {}
+
+    def fake_flash(q, k, v, causal=False, kv_valid=None):
+        calls["flash"] = k.shape[1]
+        return q
+
+    import sys
+
+    # the ops package re-exports the flash_attention FUNCTION under the same
+    # name, shadowing the submodule on attribute access — go via sys.modules
+    fa_mod = sys.modules["kubeml_tpu.ops.flash_attention"]
+    monkeypatch.setattr(fa_mod, "flash_attention", fake_flash)
+    monkeypatch.setattr(att.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(att, "FLASH_MIN_KV_LEN", 64)
+    monkeypatch.setattr(att, "FLASH_MAX_KV_LEN", 128)
+    q, k, v = qkv(rng, b=1, l=128, h=1, d=8)
+    att.dot_product_attention(q, k, v, causal=True)  # at the cap: flash
+    assert calls.get("flash") == 128
+    calls.clear()
+    q, k, v = qkv(rng, b=1, l=256, h=1, d=8)
+    out = att.dot_product_attention(q, k, v, causal=True)  # above: XLA
+    assert "flash" not in calls
+    assert out.shape == q.shape
