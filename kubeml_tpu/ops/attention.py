@@ -38,13 +38,17 @@ import jax.numpy as jnp
 # threshold get the kernel; None disables.
 FLASH_MIN_KV_LEN = 4096
 
-# Upper auto-dispatch bound: the kernels keep each (batch, head)'s whole
-# padded K/V resident in VMEM (grid walks q-blocks only), which stops
-# compiling between L=8192 (measured good) and L=16384 (measured: remote
-# compile fails) on v5e. Above this, auto-dispatch falls back to XLA's
-# fused+remat path (measured 17.9k tokens/sec at L=16k) rather than crashing
-# mid-compile; a K/V-streaming grid (k-blocks as a sequential grid axis) is
-# the known fix and would lift the cap. None disables the bound.
+# Upper auto-dispatch bound. History: the original kernels kept each
+# (batch, head)'s whole padded K/V resident in VMEM and stopped compiling
+# between L=8192 (measured good) and L=16384 (measured: remote compile
+# fails) on v5e; above the bound auto-dispatch falls back to XLA's
+# fused+remat path (measured 17.9k tokens/sec at L=16k). The kernels have
+# since been rewritten to STREAM K/V through a sequential grid axis (VMEM
+# use is O(block^2), no length ceiling by design — ops/flash_attention.py),
+# and the full interpret-mode numerics suite passes, but the >8k regime has
+# not been RE-MEASURED on the chip yet (the dev TPU went down mid-round), so
+# the conservative bound stays until the measurement exists. Lift by setting
+# None once >=16k compile+win is confirmed on hardware.
 FLASH_MAX_KV_LEN = 8192
 
 

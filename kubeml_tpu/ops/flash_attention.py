@@ -1,29 +1,32 @@
-"""Pallas TPU flash-attention kernel.
+"""Pallas TPU flash-attention kernels (forward + FlashAttention-2 backward).
 
 The hot op of every transformer in the zoo (ViT/BERT/GPT — no counterpart in
 the reference, which is CNN-only; SURVEY §5 long-context: absent). The XLA
 einsum path in kubeml_tpu.ops.attention materializes the full ``[B, H, L, L]``
-score tensor in HBM; this kernel streams K/V blocks through VMEM with the
-online-softmax recurrence so scores never leave the chip, and the two matmuls
-per block land on the MXU as clean ``[block_q, D] x [D, block_k]`` /
-``[block_q, block_k] x [block_k, D]`` contractions.
+score tensor in HBM; these kernels stream K/V blocks through VMEM with the
+online-softmax recurrence so scores never leave the chip, and the matmuls per
+block land on the MXU as clean ``[block_q, D] x [D, block_k]`` contractions.
 
-Grid layout: one program per (batch, head, q-block); K/V for that (batch,
-head) stay VMEM-resident and the kernel walks them in ``block_k`` slices with
-a ``fori_loop`` (causal walks only up to the diagonal). Padding to block
-multiples happens in the wrapper; padded keys are masked via the ``kv_valid``
-lane so odd sequence lengths are exact.
+Grid layout — K/V STREAM instead of sitting whole in VMEM: the kv-block index
+is the innermost grid axis (sequential on TPU), the online-softmax carry
+(acc/m/l) lives in VMEM scratch across those iterations, and the output block
+is revisited (its index map is constant along the kv axis) so it is written
+once at the final kv step. VMEM per program is therefore O(block^2), NOT
+O(L x D) — sequence length is bounded by HBM, not by the ~16 MB VMEM (the
+previous whole-K/V-resident design stopped compiling between 8k and 16k).
+Causal programs skip the matmul work of blocks above the diagonal with
+``pl.when`` (the grid still visits them; the carry just passes through).
 
-Backward is a pair of Pallas kernels (FlashAttention-2 style): the forward
-additionally writes the per-row logsumexp, and the backward recomputes P
-tile-by-tile in VMEM from (q, k, lse) — so the ``[L, L]`` score matrix never
-exists in HBM in EITHER direction. ``_dq_kernel`` walks K/V blocks per q-block
-(like the forward); ``_dkv_kernel`` walks Q/dO blocks per k-block, so every
-output block is produced by exactly one program and no cross-program
-accumulation is needed. The row term ``D = rowsum(dO * O)`` is a cheap
-elementwise XLA op outside the kernels.
+Backward is FlashAttention-2 style: the forward additionally writes per-row
+logsumexp; ``_dq_kernel`` accumulates dQ across the kv grid axis, and
+``_dkv_kernel`` accumulates dK/dV across a q grid axis, both recomputing the
+probability tiles in VMEM from (q, k, lse) — the ``[L, L]`` score matrix never
+exists in HBM in EITHER direction. The row term ``D = rowsum(dO * O)`` is a
+cheap elementwise XLA op outside the kernels.
 
-Set ``interpret=True`` (automatic off-TPU) to run the same kernel on CPU for
+Padding to block multiples happens in the wrapper; padded keys are masked via
+the ``kv_valid`` lane so odd sequence lengths are exact. Set
+``interpret=True`` (automatic off-TPU) to run the same kernels on CPU for
 tests.
 """
 
@@ -35,72 +38,18 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -1e30  # large-negative instead of -inf keeps exp() NaN-free for fully
 # masked rows (same trick as kubeml_tpu.parallel.ring)
 
+# lane width of the m/l carry scratch (scalar-per-row state broadcast across
+# the minor dimension so the scratch tiles legally)
+_LANES = 128
+
 
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
-
-
-def _fa_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, lse_ref, *, causal: bool,
-               block_k: int):
-    """One (batch, head, q-block) program: online softmax over K/V blocks.
-    Also writes the per-row logsumexp (the backward's softmax residual)."""
-    q = q_ref[0, 0].astype(jnp.float32)  # [BQ, D]
-    bq, d = q.shape
-    lk = k_ref.shape[2]
-    scale = 1.0 / jnp.sqrt(jnp.float32(d))
-    q_start = pl.program_id(2) * bq
-
-    def body(j, carry):
-        acc, m, l = carry
-        # whenever the loop runs >1 iteration, block_k == 128, so the offset is
-        # lane-aligned; the hint lets Mosaic prove it statically
-        off = pl.multiple_of(j * block_k, block_k)
-        k_blk = k_ref[0, 0, pl.ds(off, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(off, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(  # [BQ, BK] — q @ k^T on the MXU
-            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        valid_blk = valid_ref[0, 0:1, pl.ds(off, block_k)]  # [1, BK]
-        s = jnp.where(valid_blk > 0, s, _NEG)
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, _NEG)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))  # [BQ, 1]
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        p = jnp.where(s <= _NEG / 2, 0.0, p)  # fully-masked rows stay exactly 0
-        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(  # [BQ, D] — p @ v on the MXU
-            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return acc * alpha + pv, m_new, l_new
-
-    if causal:
-        # blocks strictly above the diagonal contribute nothing — skip them
-        n_blocks = jnp.minimum((q_start + bq + block_k - 1) // block_k, lk // block_k)
-    else:
-        n_blocks = lk // block_k
-    acc, m, l = jax.lax.fori_loop(
-        0,
-        n_blocks,
-        body,
-        (
-            jnp.zeros((bq, d), jnp.float32),
-            jnp.full((bq, 1), _NEG, jnp.float32),
-            jnp.zeros((bq, 1), jnp.float32),
-        ),
-    )
-    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-9)).astype(o_ref.dtype)
-    # logsumexp per row; fully-masked rows keep a huge-negative lse so the
-    # backward's exp(s - lse) stays zero through the same s <= _NEG/2 guard.
-    # (rank-4 [B, H, 1, Lqp] with a unit axis: Mosaic's (8, 128) tile rule
-    # wants the block's second-minor dim to equal the array dim)
-    lse_ref[0, 0, 0] = (m + jnp.log(jnp.maximum(l, 1e-9)))[:, 0]
 
 
 def _blocks_for(lq: int, lk: int, block_q: int, block_k: int, interpret: bool):
@@ -119,11 +68,81 @@ def _prep(t, lp):
     return jnp.pad(t, ((0, 0), (0, 0), (0, lp - t.shape[2]), (0, 0)))
 
 
+def _masked_scores(q, k_blk, valid_blk, q_start, k_start, causal, scale):
+    """[BQ, BK] scaled scores with kv-valid and causal masking applied."""
+    s = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    s = jnp.where(valid_blk > 0, s, _NEG)
+    if causal:
+        bq, bk = s.shape
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(k_pos <= q_pos, s, _NEG)
+    return s
+
+
+# --- forward ---
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, lse_ref,
+               acc_ref, m_ref, l_ref, *, causal: bool, n_kv: int):
+    """One (batch, head, q-block, kv-block) program. The kv axis is the
+    innermost (sequential) grid dimension; acc/m/l carry across it in VMEM
+    scratch, and o/lse are written at the final kv step."""
+    nq = pl.program_id(2)
+    nk = pl.program_id(3)
+    bq, d = q_ref.shape[2], q_ref.shape[3]
+    bk = k_ref.shape[2]
+    q_start = nq * bq
+    k_start = nk * bk
+
+    @pl.when(nk == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: blocks strictly above the diagonal contribute nothing
+    work = True if not causal else (k_start <= q_start + bq - 1)
+
+    @pl.when(work)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k_blk = k_ref[0, 0].astype(jnp.float32)
+        v_blk = v_ref[0, 0].astype(jnp.float32)
+        scale = 1.0 / jnp.sqrt(jnp.float32(d))
+        s = _masked_scores(q, k_blk, valid_ref[0, 0:1, :], q_start, k_start,
+                           causal, scale)
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(s <= _NEG / 2, 0.0, p)  # fully-masked rows stay exactly 0
+        l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(nk == n_kv - 1)
+    def _finalize():
+        l = l_ref[:, 0:1]
+        o_ref[0, 0] = (acc_ref[...] / jnp.maximum(l, 1e-9)).astype(o_ref.dtype)
+        # logsumexp per row; fully-masked rows keep a huge-negative lse so the
+        # backward's exp(s - lse) stays zero through the same s <= _NEG/2 guard
+        lse_ref[0, 0, 0] = (m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-9)))
+
+
 def _flash_fwd_impl(q, k, v, valid, *, causal: bool, block_q: int, block_k: int,
                     interpret: bool, return_lse: bool = False):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     bq, bk, lqp, lkp = _blocks_for(lq, lk, block_q, block_k, interpret)
+    n_kv = lkp // bk
 
     # padded keys are marked invalid so odd lengths stay exact; padded queries
     # are sliced off after the call
@@ -133,21 +152,26 @@ def _flash_fwd_impl(q, k, v, valid, *, causal: bool, block_q: int, block_k: int,
     valid_p = jnp.pad(valid.astype(jnp.float32), ((0, 0), (0, lkp - lk)))[:, None, :]
 
     out, lse = pl.pallas_call(
-        functools.partial(_fa_kernel, causal=causal, block_k=bk),
-        grid=(b, h, lqp // bq),
+        functools.partial(_fa_kernel, causal=causal, n_kv=n_kv),
+        grid=(b, h, lqp // bq, n_kv),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda i, j, n: (i, j, n, 0)),
-            pl.BlockSpec((1, 1, lkp, d), lambda i, j, n: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, lkp, d), lambda i, j, n: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, lkp), lambda i, j, n: (i, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda i, j, nq, nk: (i, j, nq, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda i, j, nq, nk: (i, j, nk, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda i, j, nq, nk: (i, j, nk, 0)),
+            pl.BlockSpec((1, 1, bk), lambda i, j, nq, nk: (i, 0, nk)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda i, j, n: (i, j, n, 0)),
-            pl.BlockSpec((1, 1, 1, bq), lambda i, j, n: (i, j, 0, n)),
+            pl.BlockSpec((1, 1, bq, d), lambda i, j, nq, nk: (i, j, nq, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda i, j, nq, nk: (i, j, 0, nq)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, lqp, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, 1, lqp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),       # acc
+            pltpu.VMEM((bq, _LANES), jnp.float32),  # m (row max, lane-replicated)
+            pltpu.VMEM((bq, _LANES), jnp.float32),  # l (row sum, lane-replicated)
         ],
         interpret=interpret,
     )(qt, kt, vt, valid_p)
@@ -157,100 +181,97 @@ def _flash_fwd_impl(q, k, v, valid, *, causal: bool, block_q: int, block_k: int,
     return out
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, valid_ref, lse_ref, do_ref, dsum_ref, dq_ref,
-               *, causal: bool, block_k: int):
-    """dQ for one (batch, head, q-block): walk K/V blocks, recompute P from
-    (q, k, lse), accumulate dS @ K (FlashAttention-2 backward, dQ half)."""
-    q = q_ref[0, 0].astype(jnp.float32)      # [BQ, D]
-    do = do_ref[0, 0].astype(jnp.float32)    # [BQ, D]
-    lse = lse_ref[0, 0, 0][:, None]          # [BQ, 1]
-    dsum = dsum_ref[0, 0, 0][:, None]        # [BQ, 1]
-    bq, d = q.shape
-    lk = k_ref.shape[2]
-    scale = 1.0 / jnp.sqrt(jnp.float32(d))
-    q_start = pl.program_id(2) * bq
+# --- backward ---
 
-    def body(j, acc):
-        off = pl.multiple_of(j * block_k, block_k)
-        k_blk = k_ref[0, 0, pl.ds(off, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(off, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        valid_blk = valid_ref[0, 0:1, pl.ds(off, block_k)]
-        s = jnp.where(valid_blk > 0, s, _NEG)
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, _NEG)
+
+def _dq_kernel(q_ref, k_ref, v_ref, valid_ref, lse_ref, do_ref, dsum_ref,
+               dq_ref, acc_ref, *, causal: bool, n_kv: int):
+    """dQ for one (batch, head, q-block): the kv grid axis streams K/V while
+    dQ accumulates in scratch (FlashAttention-2 backward, dQ half)."""
+    nq = pl.program_id(2)
+    nk = pl.program_id(3)
+    bq, d = q_ref.shape[2], q_ref.shape[3]
+    bk = k_ref.shape[2]
+    q_start = nq * bq
+    k_start = nk * bk
+
+    @pl.when(nk == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    work = True if not causal else (k_start <= q_start + bq - 1)
+
+    @pl.when(work)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, 0][:, None]
+        dsum = dsum_ref[0, 0, 0][:, None]
+        k_blk = k_ref[0, 0].astype(jnp.float32)
+        v_blk = v_ref[0, 0].astype(jnp.float32)
+        scale = 1.0 / jnp.sqrt(jnp.float32(d))
+        s = _masked_scores(q, k_blk, valid_ref[0, 0:1, :], q_start, k_start,
+                           causal, scale)
         p = jnp.where(s <= _NEG / 2, 0.0, jnp.exp(s - lse))  # [BQ, BK]
         dp = jax.lax.dot_general(  # dO @ V^T -> [BQ, BK]
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - dsum) * scale
-        return acc + jax.lax.dot_general(  # dS @ K -> [BQ, D]
+        acc_ref[...] = acc_ref[...] + jax.lax.dot_general(  # dS @ K -> [BQ, D]
             ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    if causal:
-        n_blocks = jnp.minimum((q_start + bq + block_k - 1) // block_k, lk // block_k)
-    else:
-        n_blocks = lk // block_k
-    acc = jax.lax.fori_loop(0, n_blocks, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0, 0] = acc.astype(dq_ref.dtype)
+    @pl.when(nk == n_kv - 1)
+    def _finalize():
+        dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(k_ref, v_ref, q_ref, valid_ref, lse_ref, do_ref, dsum_ref,
-                dk_ref, dv_ref, *, causal: bool, block_q: int):
-    """dK/dV for one (batch, head, k-block): walk Q/dO blocks. Each output
-    block is produced by exactly one program — no cross-program accumulation."""
-    k_blk = k_ref[0, 0].astype(jnp.float32)  # [BK, D]
-    v_blk = v_ref[0, 0].astype(jnp.float32)  # [BK, D]
-    bk, d = k_blk.shape
-    lq = q_ref.shape[2]
-    scale = 1.0 / jnp.sqrt(jnp.float32(d))
-    k_start = pl.program_id(2) * bk
-    valid_blk = valid_ref[0, 0:1, :]  # [1, BK] (blocked spec)
+                dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool, n_q: int):
+    """dK/dV for one (batch, head, k-block): the q grid axis streams Q/dO
+    while dK/dV accumulate in scratch."""
+    nk = pl.program_id(2)
+    nq = pl.program_id(3)
+    bk, d = k_ref.shape[2], k_ref.shape[3]
+    bq = q_ref.shape[2]
+    k_start = nk * bk
+    q_start = nq * bq
 
-    def body(i, carry):
-        dk_acc, dv_acc = carry
-        off = pl.multiple_of(i * block_q, block_q)
-        q_blk = q_ref[0, 0, pl.ds(off, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, 0, pl.ds(off, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, 0, 0, pl.ds(off, block_q)][:, None]    # [BQ, 1]
-        dsum_blk = dsum_ref[0, 0, 0, pl.ds(off, block_q)][:, None]  # [BQ, 1]
-        s = jax.lax.dot_general(  # [BQ, BK]
-            q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        s = jnp.where(valid_blk > 0, s, _NEG)
-        if causal:
-            q_pos = off + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-            s = jnp.where(k_pos <= q_pos, s, _NEG)
+    @pl.when(nq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # causal: q blocks entirely above this k block contribute nothing
+    work = True if not causal else (q_start + bq - 1 >= k_start)
+
+    @pl.when(work)
+    def _step():
+        k_blk = k_ref[0, 0].astype(jnp.float32)
+        v_blk = v_ref[0, 0].astype(jnp.float32)
+        q_blk = q_ref[0, 0].astype(jnp.float32)
+        do_blk = do_ref[0, 0].astype(jnp.float32)
+        lse_blk = lse_ref[0, 0, 0][:, None]
+        dsum_blk = dsum_ref[0, 0, 0][:, None]
+        scale = 1.0 / jnp.sqrt(jnp.float32(d))
+        s = _masked_scores(q_blk, k_blk, valid_ref[0, 0:1, :], q_start, k_start,
+                           causal, scale)
         p = jnp.where(s <= _NEG / 2, 0.0, jnp.exp(s - lse_blk))  # [BQ, BK]
-        dv_acc = dv_acc + jax.lax.dot_general(  # P^T @ dO -> [BK, D]
+        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(  # P^T @ dO -> [BK, D]
             p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(  # dO @ V^T -> [BQ, BK]
             do_blk, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - dsum_blk) * scale
-        dk_acc = dk_acc + jax.lax.dot_general(  # dS^T @ Q -> [BK, D]
+        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(  # dS^T @ Q -> [BK, D]
             ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return dk_acc, dv_acc
 
-    if causal:
-        # q-blocks strictly above this k-block's diagonal contribute nothing
-        start = k_start // block_q
-        n_blocks = lq // block_q
-        init = (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32))
-        dk_acc, dv_acc = jax.lax.fori_loop(start, n_blocks, body, init)
-    else:
-        init = (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32))
-        dk_acc, dv_acc = jax.lax.fori_loop(0, lq // block_q, body, init)
-    dk_ref[0, 0] = dk_acc.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv_acc.astype(dv_ref.dtype)
+    @pl.when(nq == n_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd_impl(q, k, v, valid, lse, out, do, *, causal: bool, block_q: int,
@@ -258,10 +279,11 @@ def _flash_bwd_impl(q, k, v, valid, lse, out, do, *, causal: bool, block_q: int,
     """Pallas backward: dq from the q-grid kernel, dk/dv from the k-grid one.
     The score matrix is recomputed tile-by-tile in VMEM — the HBM residuals
     are O(L) (q, k, v, out, lse), never the [L, L] scores. ``lse`` arrives
-    padded [B, H, Lqp] straight from the forward."""
+    padded [B, H, 1, Lqp] straight from the forward."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
     bq, bk, lqp, lkp = _blocks_for(lq, lk, block_q, block_k, interpret)
+    n_q, n_kv = lqp // bq, lkp // bk
 
     qt, kt, vt = _prep(q, lqp), _prep(k, lkp), _prep(v, lkp)
     dot = _prep(do, lqp)
@@ -273,41 +295,46 @@ def _flash_bwd_impl(q, k, v, valid, lse, out, do, *, causal: bool, block_q: int,
     dsum = jnp.pad(dsum, ((0, 0), (0, 0), (0, lqp - lq)))[:, :, None, :]
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, causal=causal, block_k=bk),
-        grid=(b, h, lqp // bq),
+        functools.partial(_dq_kernel, causal=causal, n_kv=n_kv),
+        grid=(b, h, n_q, n_kv),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda i, j, n: (i, j, n, 0)),
-            pl.BlockSpec((1, 1, lkp, d), lambda i, j, n: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, lkp, d), lambda i, j, n: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, lkp), lambda i, j, n: (i, 0, 0)),
-            pl.BlockSpec((1, 1, 1, bq), lambda i, j, n: (i, j, 0, n)),
-            pl.BlockSpec((1, 1, bq, d), lambda i, j, n: (i, j, n, 0)),
-            pl.BlockSpec((1, 1, 1, bq), lambda i, j, n: (i, j, 0, n)),
+            pl.BlockSpec((1, 1, bq, d), lambda i, j, nq, nk: (i, j, nq, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda i, j, nq, nk: (i, j, nk, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda i, j, nq, nk: (i, j, nk, 0)),
+            pl.BlockSpec((1, 1, bk), lambda i, j, nq, nk: (i, 0, nk)),
+            pl.BlockSpec((1, 1, 1, bq), lambda i, j, nq, nk: (i, j, 0, nq)),
+            pl.BlockSpec((1, 1, bq, d), lambda i, j, nq, nk: (i, j, nq, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda i, j, nq, nk: (i, j, 0, nq)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, d), lambda i, j, n: (i, j, n, 0)),
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda i, j, nq, nk: (i, j, nq, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, lqp, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt, valid_p, lse, dot, dsum)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, causal=causal, block_q=bq),
-        grid=(b, h, lkp // bk),
+        functools.partial(_dkv_kernel, causal=causal, n_q=n_q),
+        grid=(b, h, n_kv, n_q),
         in_specs=[
-            pl.BlockSpec((1, 1, bk, d), lambda i, j, n: (i, j, n, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda i, j, n: (i, j, n, 0)),
-            pl.BlockSpec((1, 1, lqp, d), lambda i, j, n: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, bk), lambda i, j, n: (i, 0, n)),
-            pl.BlockSpec((1, 1, 1, lqp), lambda i, j, n: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, lqp, d), lambda i, j, n: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, 1, lqp), lambda i, j, n: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda i, j, nk, nq: (i, j, nk, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda i, j, nk, nq: (i, j, nk, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda i, j, nk, nq: (i, j, nq, 0)),
+            pl.BlockSpec((1, 1, bk), lambda i, j, nk, nq: (i, 0, nk)),
+            pl.BlockSpec((1, 1, 1, bq), lambda i, j, nk, nq: (i, j, 0, nq)),
+            pl.BlockSpec((1, 1, bq, d), lambda i, j, nk, nq: (i, j, nq, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda i, j, nk, nq: (i, j, 0, nq)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bk, d), lambda i, j, n: (i, j, n, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda i, j, n: (i, j, n, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda i, j, nk, nq: (i, j, nk, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda i, j, nk, nq: (i, j, nk, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, lkp, d), k.dtype),
             jax.ShapeDtypeStruct((b, h, lkp, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
     )(kt, vt, qt, valid_p, lse, dot, dsum)
@@ -319,9 +346,9 @@ def _flash_bwd_impl(q, k, v, valid, lse, out, do, *, causal: bool, block_q: int,
 
 
 def _xla_reference(q, k, v, valid, causal: bool):
-    """Plain-XLA attention with the same (causal, kv_valid) masking — used for
-    the rematerialized backward and as the numerics oracle in tests. Delegates
-    the mask construction to the dispatch layer so the semantics live once."""
+    """Plain-XLA attention with the same (causal, kv_valid) masking — used as
+    the numerics oracle in tests. Delegates the mask construction to the
+    dispatch layer so the semantics live once."""
     from .attention import dot_product_attention
 
     return dot_product_attention(q, k, v, causal=causal, kv_valid=valid, impl="xla")
@@ -362,7 +389,7 @@ def flash_attention(
     block_k: int = 128,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """Flash attention; returns [B, Lq, H, D]. Differentiable (recompute bwd)."""
+    """Flash attention; returns [B, Lq, H, D]. Differentiable (Pallas bwd)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if kv_valid is None:
