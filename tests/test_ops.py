@@ -235,3 +235,19 @@ def test_dispatch_caps_at_max_kv_len(rng, monkeypatch):
     out = att.dot_product_attention(q, k, v, causal=True)  # above: XLA
     assert "flash" not in calls
     assert out.shape == q.shape
+
+
+def test_flash_streaming_many_kv_blocks(rng):
+    """Deep kv-stream coverage: 32 kv grid steps per q block (L=256, block 8
+    in interpret mode) through forward AND backward — the carry
+    init/accumulate/finalize pattern must hold over long streams."""
+    q, k, v = qkv(rng, b=1, l=256, h=1, d=8)
+    np.testing.assert_allclose(
+        flash_attention(q, k, v, causal=True),
+        oracle(q, k, v, causal=True), rtol=1e-4, atol=1e-4,
+    )
+    cot = rng.normal(size=q.shape).astype(np.float32)
+    _, vjp_f = jax.vjp(lambda q, k, v: flash_attention(q, k, v, causal=True), q, k, v)
+    _, vjp_x = jax.vjp(lambda q, k, v: oracle(q, k, v, causal=True), q, k, v)
+    for a, b in zip(vjp_f(jnp.asarray(cot)), vjp_x(jnp.asarray(cot))):
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-3)
