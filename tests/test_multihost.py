@@ -187,3 +187,28 @@ def test_spmd_elastic_device_count_keeps_model_groups_on_one_host():
                 d = spmd_elastic_device_count(p, 16, model, size)
                 assert d % size == 0
                 assert (d // size) % model == 0
+
+
+def test_broadcast_key_gc(tmp_path):
+    """The leader's lagged deletion bounds coordinator memory: keys older
+    than the GC window disappear from the KV store, recent keys survive, and
+    followers consume the full stream correctly meanwhile."""
+    import os
+
+    port = _free_port()
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(REPO / "tests" / "multihost_gc_proc.py"),
+             str(rank), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=str(REPO), env=env,
+        )
+        for rank in (0, 1)
+    ]
+    outs = [p.communicate(timeout=180)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+    leader = next(o for o in outs if "old_deleted" in o)
+    assert "old_deleted=True" in leader
+    assert "recent_present=True" in leader
