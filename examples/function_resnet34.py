@@ -7,7 +7,6 @@ slab (quantized bytes), normalization on device, LR decay via the epoch-aware
 optimizer hook."""
 
 import jax.numpy as jnp
-import numpy as np
 import optax
 
 from kubeml_tpu.data import transforms as T
@@ -30,7 +29,9 @@ class Cifar10(KubeDataset):
 
 
 class Model(KubeModel):
-    # configure_optimizers reads self.epoch -> retrace per epoch
+    # configure_optimizers reads self.epoch; written with jnp ops, so the
+    # engine traces the schedule ONCE and feeds the epoch in at runtime —
+    # no recompile at the decay boundaries
     epoch_in_schedule = True
 
     def __init__(self):
@@ -46,6 +47,9 @@ class Model(KubeModel):
         return (x - mean) / std
 
     def configure_optimizers(self):
-        # the reference decays lr /10 at epochs 25 and 40
-        lr = self.lr * (0.1 ** int(np.searchsorted([25, 40], self.epoch, side="right")))
+        # the reference decays lr /10 at epochs 25 and 40. jnp (not int/np)
+        # keeps the schedule traceable: one executable serves every epoch,
+        # with self.epoch a runtime scalar
+        lr = self.lr * (0.1 ** jnp.searchsorted(
+            jnp.asarray([25, 40]), self.epoch, side="right"))
         return optax.sgd(lr, momentum=0.9)

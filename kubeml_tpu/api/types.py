@@ -228,6 +228,9 @@ class History(_JsonMixin):
     train_loss: List[float] = field(default_factory=list)
     parallelism: List[int] = field(default_factory=list)
     epoch_duration: List[float] = field(default_factory=list)
+    # operational notes surfaced to the user (e.g. requested parallelism
+    # rounded to a host-count multiple); absent in reference histories
+    notes: List[str] = field(default_factory=list)
 
     def append_epoch(
         self,

@@ -92,11 +92,19 @@ class TrainJob:
                                  "multi-host mode")
 
         self.parallelism = request.options.default_parallelism
+        self._pending_notes: list = []
         if dist is not None and dist.size > 1:
             # the worker axis must split evenly across host processes
+            requested = self.parallelism
             self.parallelism = max(
-                dist.size, (self.parallelism // dist.size) * dist.size
+                dist.size, (requested // dist.size) * dist.size
             )
+            if self.parallelism != requested:
+                note = (f"requested parallelism {requested} rounded to "
+                        f"{self.parallelism} (must be a multiple of the "
+                        f"{dist.size} host processes)")
+                log.warning("%s: %s", job_id, note)
+                self._pending_notes.append(note)
         self.trainer = KAvgTrainer(
             model, precision=request.options.precision, devices=devices,
             donate=request.options.donate, mesh_shape=request.options.mesh_shape,
@@ -110,10 +118,15 @@ class TrainJob:
         self.tracer = get_tracer()
 
         self.history = History(id=job_id, task={"request": request.to_dict()})
+        self.history.notes.extend(self._pending_notes)
         self.stop_event = threading.Event()
         self.exit_error: Optional[str] = None
         self._stacked_vars = None
         self._final_variables = None
+        # leader-held host copy of the newest checkpointed weights, so /infer
+        # can answer DURING multi-host training (serving the live global array
+        # would need a collective the followers aren't at); (variables, epoch)
+        self._latest_snapshot: Optional[tuple] = None
         # in-flight async checkpoint write (at most one; see _save_checkpoint)
         self._ckpt_thread: Optional[threading.Thread] = None
 
@@ -208,10 +221,18 @@ class TrainJob:
                         _, p = self.dist.broadcast_flags(parallelism=new_p or 0)
                         new_p = p or None
                         if new_p and self.dist.size > 1:
+                            asked = new_p
                             new_p = max(
                                 self.dist.size,
-                                (new_p // self.dist.size) * self.dist.size,
+                                (asked // self.dist.size) * self.dist.size,
                             )
+                            if new_p != asked:
+                                note = (f"epoch {epoch + 1}: scheduler "
+                                        f"parallelism {asked} rounded to "
+                                        f"{new_p} (multiple of "
+                                        f"{self.dist.size} host processes)")
+                                log.warning("%s: %s", self.job_id, note)
+                                self.history.notes.append(note)
                     if new_p and new_p != self.parallelism:
                         log.info(
                             "%s: parallelism %d -> %d", self.job_id, self.parallelism, new_p
@@ -567,6 +588,7 @@ class TrainJob:
             "accuracy": list(h.accuracy),
             "parallelism": list(h.parallelism),
             "epoch_duration": list(h.epoch_duration),
+            "notes": list(h.notes),
         }
 
     def _join_checkpoint(self) -> None:
@@ -595,6 +617,9 @@ class TrainJob:
                 variables = self._snapshot_reference()
                 if not self._leader:
                     return
+                # mid-training serving snapshot (tuple assignment is atomic
+                # under the GIL — the HTTP thread reads it)
+                self._latest_snapshot = (variables, epoch)
                 meta = {"request": self.request.to_dict(),
                         "history": self._history_lists()}
 
@@ -681,11 +706,52 @@ class TrainJob:
         if self._stacked_vars is None:
             raise KubeMLError(f"job {self.job_id} has no model yet", 400)
         if self.dist is not None and self.dist.size > 1:
-            # serving mid-training would need a collective the follower
-            # processes are not at (they are inside the training loop); the
-            # finished model serves from the leader's final checkpoint instead
-            raise KubeMLError(
-                f"job {self.job_id} is training multi-host; inference is "
-                f"served from its checkpoint after it finishes", 409
-            )
+            # serving from the live global array would need a collective the
+            # follower processes are not at (they are inside the training
+            # loop), so multi-host jobs serve from the LATEST CHECKPOINTED
+            # weights instead — the answer trails training by up to
+            # checkpoint_every epochs (the reference's PS serves whatever the
+            # model id resolves to mid-training, ml/pkg/scheduler/api.go:119-162,
+            # which is equally stale between merges)
+            if self._final_variables is not None:
+                return self.trainer.infer_from_host(self._final_variables, x)
+            snap = self._latest_snapshot
+            if snap is None:
+                snap = self._restore_serving_snapshot()
+            if snap is None:
+                every = self.request.options.checkpoint_every
+                detail = (
+                    f"retry after the first checkpoint (checkpoint_every={every})"
+                    if every > 0 else
+                    "it runs without checkpoints (checkpoint_every=0), so "
+                    "inference is available once it finishes"
+                )
+                raise KubeMLError(
+                    f"job {self.job_id} is training multi-host and has no "
+                    f"checkpoint yet; {detail}", 409,
+                )
+            return self.trainer.infer_from_host(snap[0], x)
         return self.trainer.infer(self._stacked_vars, x)
+
+    def _restore_serving_snapshot(self):
+        """Fallback for mid-training serving after a runner restart: pull the
+        newest epoch checkpoint off disk (leader-written)."""
+        if not self._leader:
+            return None
+        try:
+            from .resume import select_resume_checkpoint
+
+            best = select_resume_checkpoint(self.checkpoint_store, self.job_id)
+            if best is None:
+                return None
+            _, ck = best
+            # ck.epoch is the epoch the weights were saved at (select's first
+            # element is the RESUME epoch, one past it). Never clobber a
+            # snapshot the training thread published while we read the disk —
+            # it is at least as fresh as anything on disk.
+            if self._latest_snapshot is None:
+                self._latest_snapshot = (ck.variables, ck.epoch)
+            return self._latest_snapshot
+        except Exception:
+            log.exception("%s: serving-snapshot restore failed", self.job_id)
+            return None

@@ -38,5 +38,12 @@ def select_resume_checkpoint(
 def extend_history(history, ck: Checkpoint) -> None:
     """Splice the checkpoint's recorded history lists back onto a fresh History."""
     for key, vals in ck.meta.get("history", {}).items():
-        if hasattr(history, key):
-            getattr(history, key).extend(vals)
+        if not hasattr(history, key):
+            continue
+        target = getattr(history, key)
+        if key == "notes":
+            # the resumed job re-generates setup notes (e.g. parallelism
+            # rounding) in its own __init__ — don't double-record them
+            target.extend(v for v in vals if v not in target)
+        else:
+            target.extend(vals)

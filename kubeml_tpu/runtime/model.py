@@ -48,9 +48,11 @@ class KubeModel(ABC):
     """
 
     # Set True in a subclass whose configure_optimizers reads self.epoch (e.g.
-    # epoch-based lr decay, reference function_resnet34.py:52-63): the engine then
-    # re-traces the sync round when the epoch changes. Left False (default), one
-    # compiled program serves every epoch.
+    # epoch-based lr decay, reference function_resnet34.py:52-63): the engine
+    # then feeds the current epoch to the schedule. Schedules written with jnp
+    # ops compile ONCE (lr/epoch are runtime scalars in the program); Python
+    # control flow on self.epoch (int(), if-chains) falls back to one compile
+    # per (lr, epoch). Left False (default), the schedule never sees the epoch.
     epoch_in_schedule: bool = False
 
     def __init__(self, dataset: KubeDataset):

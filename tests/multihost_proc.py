@@ -75,6 +75,97 @@ def _run_spmd_job(cluster, result) -> None:
     )
 
 
+def _run_infer_mode(cluster, result) -> None:
+    """K-AVG job with per-epoch checkpoints; the leader serves /infer WHILE
+    the job trains (from the newest checkpoint snapshot — reference serves
+    mid-training too, ml/pkg/scheduler/api.go:119-162). Also requests
+    parallelism 3 on an even host count, which must be rounded down and
+    noted in the history."""
+    import time
+
+    import numpy as np
+
+    from kubeml_tpu.api.errors import KubeMLError
+    from kubeml_tpu.api.types import JobState, TrainOptions, TrainRequest, TrainTask
+
+    src = (
+        "import optax\n"
+        "from kubeml_tpu.data.dataset import KubeDataset\n"
+        "from kubeml_tpu.models.lenet import LeNet\n"
+        "from kubeml_tpu.runtime.model import KubeModel\n"
+        "class DS(KubeDataset):\n"
+        "    def __init__(self):\n"
+        "        super().__init__('digits')\n"
+        "class Model(KubeModel):\n"
+        "    def __init__(self):\n"
+        "        super().__init__(DS())\n"
+        "    def build(self):\n"
+        "        return LeNet(num_classes=10)\n"
+        "    def preprocess(self, x):\n"
+        "        return x.astype('float32') / 255.0\n"
+        "    def configure_optimizers(self):\n"
+        "        return optax.sgd(self.lr)\n"
+        "def main():\n"
+        "    return Model()\n"
+    )
+    cluster.registry.create("mhfn", src)
+    r = np.random.default_rng(0)
+    xtr = r.integers(0, 256, (512, 14, 14, 1), dtype=np.uint8)
+    ytr = (xtr.reshape(512, 14, 14).mean(axis=2).argmax(axis=1) % 10).astype(np.int64)
+    cluster.store.create("digits", xtr, ytr, xtr[:128], ytr[:128])
+
+    nprocs = int(sys.argv[2])
+    # 8 epochs: the poller needs the job ALIVE after the first checkpoint
+    # lands (epoch 1) — with per-epoch checkpoints and ~1s epochs, 7 more
+    # epochs leave a wide mid-training window even on a fast box
+    req = TrainRequest(
+        dataset="digits", function_name="mhfn", epochs=8, batch_size=16,
+        lr=0.05,
+        options=TrainOptions(default_parallelism=nprocs + 1, k=2,
+                             validate_every=1, checkpoint_every=1,
+                             static_parallelism=True),
+    )
+    task = TrainTask(job_id="mhinfer1", parameters=req, state=JobState())
+    cluster.ps.start_task(task)
+
+    probe = xtr[:4]
+    saw_no_checkpoint = False
+    mid_infer_shape = None
+    deadline = time.monotonic() + 540
+    while time.monotonic() < deadline:
+        # the job was live at the top of the iteration; a success below then
+        # counts as mid-training (checking again AFTER the answer would
+        # discard a valid answer whenever the job finishes under it)
+        if cluster.ps.wait(task.job_id, timeout=0.01):
+            break  # finished before a mid-training answer landed
+        try:
+            out = cluster.ps.infer(task.job_id, probe.tolist())
+        except KubeMLError as e:
+            if e.status_code == 409:
+                saw_no_checkpoint = True  # before the first checkpoint
+                time.sleep(0.2)
+                continue
+            if e.status_code == 400 and "no model yet" in e.message:
+                time.sleep(0.2)  # job thread hasn't placed weights yet
+                continue
+            raise
+        mid_infer_shape = list(np.asarray(out).shape)
+        break
+    cluster.ps.wait(task.job_id, timeout=600)
+    post = cluster.ps.infer(task.job_id, probe.tolist())
+    hist = cluster.history_store.get(task.job_id)
+    result.update(
+        status=str(task.status),
+        epochs=len(hist.train_loss),
+        train_loss=hist.train_loss,
+        parallelism=hist.parallelism,
+        notes=list(getattr(hist, "notes", [])),
+        saw_no_checkpoint=saw_no_checkpoint,
+        mid_infer_shape=mid_infer_shape,
+        post_infer_shape=list(np.asarray(post).shape),
+    )
+
+
 def main() -> int:
     rank = int(sys.argv[1])
     nprocs = int(sys.argv[2])
@@ -83,14 +174,19 @@ def main() -> int:
     # "shared" = both processes see one data root (normal deployment);
     # "split" = the follower has its own EMPTY root, so it cannot construct
     # the job — the start handshake must abort the job cleanly on the leader;
-    # "spmd" = shared root, one --engine spmd job (tp=2 across both processes)
+    # "spmd" = shared root, one --engine spmd job (tp=2 across both processes);
+    # "infer" = shared root, per-epoch checkpoints, leader serves /infer
+    # mid-training + parallelism-rounding history note
     mode = sys.argv[5] if len(sys.argv) > 5 else "shared"
     out_path = os.path.join(workdir, f"result_{rank}.json")
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    # default 2 local devices (4 global in the 2-proc tests); the 4-proc
+    # tests run 1/process so the group stays light on a small CI box
+    jax.config.update("jax_num_cpu_devices",
+                      int(os.environ.get("KUBEML_TEST_LOCAL_DEVICES", "2")))
     jax.distributed.initialize(
         coordinator_address=coordinator, num_processes=nprocs, process_id=rank
     )
@@ -127,6 +223,9 @@ def main() -> int:
         try:
             if mode == "spmd":
                 _run_spmd_job(cluster, result)
+                raise _Done
+            if mode == "infer":
+                _run_infer_mode(cluster, result)
                 raise _Done
             # deploy the function + synthetic dataset (both hosts read the
             # same data root, as a shared filesystem would provide)

@@ -99,25 +99,27 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_pair(tmp_path, mode: str):
+def _run_group(tmp_path, mode: str, nprocs: int = 2,
+               local_devices: int = 2, timeout: float = 420):
     import os
 
     port = _free_port()
     coordinator = f"127.0.0.1:{port}"
-    env = dict(os.environ, PYTHONPATH=str(REPO))
+    env = dict(os.environ, PYTHONPATH=str(REPO),
+               KUBEML_TEST_LOCAL_DEVICES=str(local_devices))
     procs = [
         subprocess.Popen(
             [sys.executable, str(REPO / "tests" / "multihost_proc.py"),
-             str(rank), "2", coordinator, str(tmp_path), mode],
+             str(rank), str(nprocs), coordinator, str(tmp_path), mode],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             cwd=str(REPO), env=env,
         )
-        for rank in (0, 1)
+        for rank in range(nprocs)
     ]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=420)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -126,8 +128,12 @@ def _run_pair(tmp_path, mode: str):
                     "\n".join(o or "" for o in outs))
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"rank process failed:\n{out}"
-    return (json.loads((tmp_path / "result_0.json").read_text()),
-            json.loads((tmp_path / "result_1.json").read_text()))
+    return [json.loads((tmp_path / f"result_{r}.json").read_text())
+            for r in range(nprocs)]
+
+
+def _run_pair(tmp_path, mode: str):
+    return _run_group(tmp_path, mode, nprocs=2)
 
 
 def test_two_process_training_job(tmp_path):
@@ -212,3 +218,67 @@ def test_broadcast_key_gc(tmp_path):
     leader = next(o for o in outs if "old_deleted" in o)
     assert "old_deleted=True" in leader
     assert "recent_present=True" in leader
+
+
+def test_two_process_mid_training_inference(tmp_path):
+    """Multi-host /infer DURING training: served from the newest epoch
+    checkpoint (reference serves mid-training whenever the model id resolves,
+    ml/pkg/scheduler/api.go:119-162), and the requested odd parallelism is
+    rounded to the host-count multiple WITH a history note."""
+    rs = _run_group(tmp_path, "infer")
+    r0 = rs[0]
+    assert "finished" in r0["status"].lower(), r0
+    # 3 requested on 2 hosts -> 2, and the history says so
+    assert r0["parallelism"] and all(p == 2 for p in r0["parallelism"])
+    assert any("rounded" in n for n in r0["notes"]), r0["notes"]
+    # inference answered while the job was still training ...
+    assert r0["mid_infer_shape"] == [4], r0  # 4 class predictions
+    # ... and still answers from the final model afterwards
+    assert r0["post_infer_shape"] == [4]
+    assert rs[1]["jobs_followed"] == 1
+
+
+# --- 4-process group (one CPU device per process) ---
+# 2 processes is the one size where whole classes of rank-indexing bugs
+# cannot show up (VERDICT r2); these repeat the integration modes at 4.
+
+
+def test_four_process_training_job(tmp_path):
+    rs = _run_group(tmp_path, "shared", nprocs=4, local_devices=1,
+                    timeout=600)
+    r0 = rs[0]
+    assert r0["global_devices"] == 4 and r0["local_devices"] == 1
+    assert "finished" in r0["status"].lower(), r0
+    assert r0["epochs"] == 3
+    import numpy as np
+    assert all(np.isfinite(v) for v in r0["train_loss"])
+    # parallelism 2 requested; on 4 hosts the worker axis rounds UP to 4
+    assert all(p % 4 == 0 for p in r0["parallelism"])
+    for r in rs[1:]:
+        assert r["jobs_followed"] == 1
+
+
+def test_four_process_spmd_job(tmp_path):
+    """tp=2 spanning a 4-process x 2-device group (8 global devices): tensor
+    groups stay within a host, data-parallel replicas span all four."""
+    rs = _run_group(tmp_path, "spmd", nprocs=4, local_devices=2,
+                    timeout=600)
+    r0 = rs[0]
+    assert r0["global_devices"] == 8
+    assert "finished" in r0["status"].lower(), r0.get("error")
+    assert r0["epochs"] == 2
+    import numpy as np
+    assert all(np.isfinite(v) for v in r0["train_loss"])
+    for r in rs[1:]:
+        assert r["jobs_followed"] == 1
+
+
+def test_four_process_follower_failure_aborts_cleanly(tmp_path):
+    rs = _run_group(tmp_path, "split", nprocs=4, local_devices=1,
+                    timeout=600)
+    r0 = rs[0]
+    assert "failed" in r0["status"].lower()
+    assert "could not start" in (r0.get("error") or "")
+    assert r0["epochs"] == 0
+    for r in rs[1:]:
+        assert r["jobs_followed"] == 0
