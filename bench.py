@@ -21,14 +21,20 @@ return early on the tunneled platform (BASELINE.md measurement note).
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
 import numpy as np
 
 
-DEVICE_PROBE_TIMEOUT_S = 240  # wedged-tunnel detection (devices() hangs in C)
-BENCH_BUDGET_S = 3600         # full budget once devices answered
+# env overrides exist for the retry-loop tests (tests/test_bench_watchdog.py)
+DEVICE_PROBE_TIMEOUT_S = float(os.environ.get("KUBEML_BENCH_PROBE_S", 240))
+# total wall budget (probe retries + bench run)
+BENCH_BUDGET_S = float(os.environ.get("KUBEML_BENCH_BUDGET_S", 3600))
+# min time a probe must leave for the bench itself (first-compile + 6 timing
+# loops + a comparator cache miss, which comparator.measure self-bounds)
+BENCH_RESERVE_S = float(os.environ.get("KUBEML_BENCH_RESERVE_S", 900))
 _METRIC = "resnet18-cifar10-kavg-train-throughput"  # keep error rows on the
 # same key main() emits (harness.flagship's resnet spec)
 
@@ -45,63 +51,92 @@ def _watchdog() -> int:
     device tunnel: jax.devices() can hang forever inside a blocking C call
     (observed mid-round-2 — not interruptible by in-process SIGALRM), and a
     hang would eat the whole bench slot. The child prints a marker as soon as
-    device discovery returns; no marker within the probe window means the
-    backend is unreachable and a diagnosable JSON line is emitted instead."""
+    device discovery returns.
+
+    The tunnel wedge is often TRANSIENT (round 2 lost its number to a single
+    240s probe that gave up), so discovery is retried with a FRESH child
+    process across the whole budget: each attempt gets its own process (a hung
+    libtpu client never recovers in-process), and attempts repeat until one
+    succeeds or too little budget remains to run the bench after it."""
     import os
     import subprocess
     import sys
     import threading
 
     env = dict(os.environ, KUBEML_BENCH_CHILD="1")
-    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
-                            stdout=subprocess.PIPE, text=True, env=env)
-    devices_ok = threading.Event()
-    lines = []
+    start = time.monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
+        proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                                stdout=subprocess.PIPE, text=True, env=env)
+        devices_ok = threading.Event()
+        lines = []
 
-    def reader():
-        for line in proc.stdout:
-            if line.startswith("DEVICES_OK"):
-                devices_ok.set()
-            else:
-                lines.append(line)
+        def reader(proc=proc, lines=lines, devices_ok=devices_ok):
+            for line in proc.stdout:
+                if line.startswith("DEVICES_OK"):
+                    devices_ok.set()
+                else:
+                    lines.append(line)
 
-    t = threading.Thread(target=reader, daemon=True)
-    t.start()
-    # poll so a child that CRASHES before the marker (e.g. an ImportError) is
-    # reported as the code bug it is, not misdiagnosed as a wedged tunnel
-    waited = 0.0
-    while not devices_ok.wait(1.0):
-        waited += 1.0
-        if proc.poll() is not None:
-            t.join(timeout=10)
-            sys.stdout.write("".join(lines))
-            print(_error_json(
-                f"bench child exited with code {proc.returncode} before "
-                f"device discovery"))
-            return 0
-        if waited >= DEVICE_PROBE_TIMEOUT_S:
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        # poll so a child that CRASHES before the marker (e.g. an ImportError)
+        # is reported as the code bug it is, not misdiagnosed as a wedged
+        # tunnel
+        waited = 0.0
+        while not devices_ok.wait(1.0):
+            waited += 1.0
+            if proc.poll() is not None:
+                t.join(timeout=10)
+                sys.stdout.write("".join(lines))
+                print(_error_json(
+                    f"bench child exited with code {proc.returncode} before "
+                    f"device discovery (attempt {attempt})"))
+                return 0
+            if waited >= DEVICE_PROBE_TIMEOUT_S:
+                break
+        if not devices_ok.is_set():
+            proc.kill()
+            proc.wait()
+            elapsed = time.monotonic() - start
+            if elapsed + DEVICE_PROBE_TIMEOUT_S + BENCH_RESERVE_S > BENCH_BUDGET_S:
+                print(_error_json(
+                    f"accelerator backend unreachable: device discovery never "
+                    f"returned within {DEVICE_PROBE_TIMEOUT_S}s across "
+                    f"{attempt} fresh-process attempts over "
+                    f"{elapsed:.0f}s (wedged device tunnel)"))
+                return 0
+            print(f"# probe attempt {attempt} timed out after "
+                  f"{DEVICE_PROBE_TIMEOUT_S}s; retrying with a fresh process "
+                  f"({elapsed:.0f}s/{BENCH_BUDGET_S}s used)",
+                  file=sys.stderr, flush=True)
+            continue
+        # discovery succeeded — give the bench the rest of the budget
+        remaining = max(BENCH_RESERVE_S,
+                        BENCH_BUDGET_S - (time.monotonic() - start))
+        try:
+            proc.wait(remaining)
+        except subprocess.TimeoutExpired:
             proc.kill()
             print(_error_json(
-                f"accelerator backend unreachable: device discovery did not "
-                f"return within {DEVICE_PROBE_TIMEOUT_S}s (wedged device "
-                f"tunnel)"))
+                f"bench exceeded remaining budget ({remaining:.0f}s) after "
+                f"device discovery"))
             return 0
-    try:
-        proc.wait(BENCH_BUDGET_S)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        print(_error_json(
-            f"bench exceeded {BENCH_BUDGET_S}s after device discovery"))
-        return 0
-    t.join(timeout=10)
-    sys.stdout.write("".join(lines))
-    return proc.returncode
+        t.join(timeout=10)
+        sys.stdout.write("".join(lines))
+        return proc.returncode
 
 
 def main():
     from kubeml_tpu.benchmarks.harness import flagship, make_synthetic_model
     from kubeml_tpu.engine.kavg import KAvgTrainer
 
+    if os.environ.get("KUBEML_BENCH_FAKE_HANG"):
+        time.sleep(10_000)  # test hook: impersonate a wedged device tunnel
+    if os.environ.get("KUBEML_BENCH_CRASH"):
+        raise RuntimeError("test hook: child crash before device discovery")
     jax.devices()
     print("DEVICES_OK", flush=True)
 
@@ -178,6 +213,14 @@ def main():
     rounds_per_sec = device_sps / samples_per_round
     mfu = mfu_from(flops, rounds_per_sec)
 
+    # MEASURED comparator denominator (the reference's own methodology —
+    # ml/experiments/common/experiment.py:263-337): a same-architecture torch
+    # training loop on this host. The old hardware-class constant survives
+    # only as the separately-labeled reference-class ratio.
+    from kubeml_tpu.benchmarks.harness import baseline_for
+
+    base_sps, base_row = baseline_for(fs)
+
     print(
         json.dumps(
             {
@@ -187,16 +230,20 @@ def main():
                 "mfu": round(mfu, 4) if mfu is not None else None,
                 "flops_per_round": flops,
                 "peak_flops": peak_flops(),
-                # apples-to-apples: fs.baseline_sps is an END-TO-END single-GPU
-                # figure, so the headline ratio uses the end-to-end number;
-                # the device-bound ratio is reported separately
-                "vs_baseline": round(e2e_sps / fs.baseline_sps, 3),
-                "vs_baseline_device": round(device_sps / fs.baseline_sps, 3),
+                # the comparator trains with its batch resident on device, so
+                # the apples-to-apples numerator is the device throughput
+                "vs_baseline": round(device_sps / base_sps, 3),
+                "baseline": base_row,
+                # labeled ESTIMATE: reference-era single-GPU class constant,
+                # against the end-to-end number (that class is end-to-end)
+                "vs_reference_class_gpu": round(e2e_sps / fs.baseline_sps, 3),
                 "end_to_end": round(e2e_sps, 1),
                 "note": "value = device throughput (slabs in HBM); end_to_end "
                         "includes staging over this dev box's ~17MB/s tunnel; "
-                        "vs_baseline compares end_to_end against the reference "
-                        "single-GPU end-to-end class",
+                        "vs_baseline divides value by the MEASURED torch "
+                        "comparator in 'baseline' (same architecture, this "
+                        "host); vs_reference_class_gpu is the old estimate, "
+                        "kept for continuity",
             }
         )
     )

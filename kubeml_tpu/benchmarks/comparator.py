@@ -1,0 +1,231 @@
+"""Measured comparator baseline: the SAME architecture trained by torch.
+
+The reference validates its speedups against a comparator harness it runs
+itself (reference: ml/experiments/common/experiment.py:263-337
+``TensorflowExperiment`` drives ml/experiments/tflow/tf_train.py on the same
+dataset/model class) — not against constants. This module is that harness for
+the TPU rebuild: a torch training loop (torch is what the reference's user
+functions run, python/kubeml/kubeml/model.py) over an architecture matched
+layer-for-layer to the flax flagship, measured on whatever device torch has
+(CUDA when available; CPU on this box), with full provenance.
+
+``bench.py`` divides its TPU throughput by this measured figure for
+``vs_baseline``. The old hardware-class constants (a 2020-era single-GPU
+estimate per model family) remain available as ``reference_class_sps`` — an
+*estimate*, reported separately and labeled as such.
+
+Measurements are cached under ``results/comparator_<name>.json`` keyed by
+torch version + device so a bench rerun doesn't pay the torch loop again.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing
+import platform
+import time
+from pathlib import Path
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+def _results_dir() -> Path:
+    return Path(__file__).resolve().parent.parent.parent / "results"
+
+
+# --- torch mirrors of the flax flagships (models/lenet.py, models/resnet.py) ---
+
+def _torch_lenet(num_classes: int = 10):
+    import torch.nn as tnn
+
+    class LeNet(tnn.Module):
+        """Mirror of models/lenet.py: conv6(5x5,same)-pool-conv16(5x5,valid)-
+        pool-120-84-classes."""
+
+        def __init__(self):
+            super().__init__()
+            self.c1 = tnn.Conv2d(1, 6, 5, padding=2)
+            self.c2 = tnn.Conv2d(6, 16, 5)
+            self.f1 = tnn.Linear(16 * 5 * 5, 120)
+            self.f2 = tnn.Linear(120, 84)
+            self.f3 = tnn.Linear(84, num_classes)
+
+        def forward(self, x):
+            import torch.nn.functional as F
+
+            x = F.max_pool2d(F.relu(self.c1(x)), 2)
+            x = F.max_pool2d(F.relu(self.c2(x)), 2)
+            x = x.flatten(1)
+            return self.f3(F.relu(self.f2(F.relu(self.f1(x)))))
+
+    return LeNet()
+
+
+def _torch_resnet18(num_classes: int = 10):
+    import torch.nn as tnn
+
+    class BasicBlock(tnn.Module):
+        """Mirror of models/resnet.py BasicBlock (3x3-3x3, projection on
+        stride/width change)."""
+
+        def __init__(self, cin, cout, stride=1):
+            super().__init__()
+            self.c1 = tnn.Conv2d(cin, cout, 3, stride=stride, padding=1, bias=False)
+            self.b1 = tnn.BatchNorm2d(cout, momentum=0.1)
+            self.c2 = tnn.Conv2d(cout, cout, 3, padding=1, bias=False)
+            self.b2 = tnn.BatchNorm2d(cout, momentum=0.1)
+            self.proj = None
+            if stride != 1 or cin != cout:
+                self.proj = tnn.Sequential(
+                    tnn.Conv2d(cin, cout, 1, stride=stride, bias=False),
+                    tnn.BatchNorm2d(cout, momentum=0.1),
+                )
+
+        def forward(self, x):
+            import torch.nn.functional as F
+
+            y = F.relu(self.b1(self.c1(x)))
+            y = self.b2(self.c2(y))
+            r = x if self.proj is None else self.proj(x)
+            return F.relu(y + r)
+
+    class ResNet18(tnn.Module):
+        """Mirror of models/resnet.py ResNet([2,2,2,2], cifar_stem=True)."""
+
+        def __init__(self):
+            super().__init__()
+            self.stem = tnn.Sequential(
+                tnn.Conv2d(3, 64, 3, padding=1, bias=False),
+                tnn.BatchNorm2d(64, momentum=0.1),
+                tnn.ReLU(),
+            )
+            layers = []
+            cin = 64
+            for i, n_blocks in enumerate([2, 2, 2, 2]):
+                cout = 64 * 2 ** i
+                for j in range(n_blocks):
+                    stride = 2 if i > 0 and j == 0 else 1
+                    layers.append(BasicBlock(cin, cout, stride))
+                    cin = cout
+            self.blocks = tnn.Sequential(*layers)
+            self.head = tnn.Linear(512, num_classes)
+
+        def forward(self, x):
+            x = self.blocks(self.stem(x))
+            return self.head(x.mean(dim=(2, 3)))
+
+    return ResNet18()
+
+
+_FACTORIES = {
+    "lenet-mnist": (_torch_lenet, (1, 28, 28)),
+    "resnet18-cifar10": (_torch_resnet18, (3, 32, 32)),
+}
+
+
+def measure(name: str, batch: int = 128, steps: int = 8, warmup: int = 2,
+            num_classes: int = 10, seed: int = 0,
+            budget_s: float = 240.0) -> Dict:
+    """Train the torch mirror of flagship ``name`` for ``steps`` measured
+    steps (same loss + optimizer family the engine benches: cross-entropy,
+    SGD momentum 0.9) and return samples/sec + provenance.
+
+    ``budget_s`` bounds the whole loop: on a very slow host the measured step
+    count shrinks (never below 2) so a comparator cache miss cannot eat the
+    bench watchdog's remaining budget and get the ALREADY-MEASURED TPU number
+    killed with it."""
+    import torch
+
+    factory, chw = _FACTORIES[name]
+    dev = torch.device("cuda" if torch.cuda.is_available() else "cpu")
+    torch.manual_seed(seed)
+    model = factory(num_classes).to(dev).train()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    loss_fn = torch.nn.CrossEntropyLoss()
+    r = np.random.default_rng(seed)
+    x = torch.tensor(
+        r.integers(0, 256, (batch, *chw)).astype(np.float32) / 127.5 - 1.0,
+        device=dev)
+    y = torch.tensor(r.integers(0, num_classes, batch), device=dev)
+
+    def step():
+        opt.zero_grad(set_to_none=True)
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        return float(loss.detach())  # value fetch: also the CUDA sync point
+
+    t_start = time.perf_counter()
+    for _ in range(warmup):
+        step()
+    per_step = max((time.perf_counter() - t_start) / max(warmup, 1), 1e-6)
+    remaining = budget_s - (time.perf_counter() - t_start)
+    steps = max(2, min(steps, int(remaining / per_step)))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        step()
+    dt = time.perf_counter() - t0
+
+    return {
+        "model": name,
+        "samples_per_sec": round(steps * batch / dt, 2),
+        "batch": batch,
+        "steps": steps,
+        "framework": f"torch-{torch.__version__}",
+        "device": str(dev),
+        "device_name": (torch.cuda.get_device_name(0)
+                        if dev.type == "cuda" else platform.processor() or "cpu"),
+        "cpu_count": multiprocessing.cpu_count(),
+        "torch_threads": torch.get_num_threads(),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "method": "same-architecture torch training loop (mirror of the flax "
+                  "flagship), cross-entropy + SGD(momentum=0.9), synthetic "
+                  "batch resident on device; comparator per the reference's "
+                  "own harness (ml/experiments/common/experiment.py:263-337)",
+    }
+
+
+def _cache_key(batch: int) -> str:
+    import torch
+
+    dev = "cuda" if torch.cuda.is_available() else "cpu"
+    # host identity and batch are part of the key: a committed cache row from
+    # one box (or another batch size) must never masquerade as this
+    # measurement; the model name is the cache FILENAME, not part of the key
+    return (f"torch-{torch.__version__}-{dev}-{platform.node()}"
+            f"-cpu{multiprocessing.cpu_count()}-b{batch}")
+
+
+def measured_baseline(name: str, batch: int = 128,
+                      refresh: bool = False) -> Optional[Dict]:
+    """The cached-or-fresh measured comparator row for flagship ``name``.
+    Returns None only if torch itself is unavailable."""
+    try:
+        import torch  # noqa: F401
+    except Exception:
+        return None
+    if name not in _FACTORIES:
+        return None
+    path = _results_dir() / f"comparator_{name}.json"
+    key = _cache_key(batch)
+    if not refresh and path.exists():
+        try:
+            row = json.loads(path.read_text())
+            if row.get("cache_key") == key:
+                return row
+        except Exception:
+            pass
+    row = measure(name, batch=batch)
+    row["cache_key"] = key
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(row, indent=1))
+    except Exception:
+        pass
+    return row
+
+
+if __name__ == "__main__":
+    for n in _FACTORIES:
+        print(json.dumps(measured_baseline(n, refresh=True)))

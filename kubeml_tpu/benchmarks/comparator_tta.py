@@ -1,0 +1,196 @@
+"""Time-to-accuracy comparator: framework vs torch on the same hardware.
+
+The reference's experiment methodology compares kubeml's time-to-accuracy
+against a plain single-device comparator it runs itself on the same corpus
+(reference: ml/experiments/common/experiment.py:263-337 drives the comparator;
+ml/experiments/app/time_to_accuracy.py:40-86 the TTA grids). This is that
+experiment for the rebuild, runnable in-environment:
+
+* **framework side** — the digits-real scenario through the LIVE control
+  plane (scheduler -> PS -> K-AVG engine, parallelism 2, K=8), i.e. all
+  framework overheads included, exactly like the reference measures itself;
+* **comparator side** — a plain torch loop (the reference's user-code
+  framework) training a layer-for-layer mirror of the same DigitsNet on the
+  same deterministic 80/20 split of the same real corpus.
+
+Both run on whatever this host offers (CPU here, 1 thread apiece; on a
+TPU-VM the framework side uses the chips and the comparison becomes the
+reference's own GPU-vs-kubeml shape). Output: one JSON row per system with
+seconds-to-goal and the ratio.
+
+Run: ``python -m kubeml_tpu.benchmarks.comparator_tta [--goal 92] [--out f]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+GOAL_ACC_PCT = 92.0  # reachable by both systems on digits in < 30 epochs
+MAX_EPOCHS = 30
+BATCH = 32
+LR = 0.05
+
+
+def _torch_digitsnet():
+    import torch.nn as tnn
+
+    class DigitsNet(tnn.Module):
+        """Mirror of benchmarks/scenarios.py DigitsNet (conv32-pool-conv64-
+        pool-fc128-fc10 on 8x8x1)."""
+
+        def __init__(self):
+            super().__init__()
+            self.c1 = tnn.Conv2d(1, 32, 3, padding=1)
+            self.c2 = tnn.Conv2d(32, 64, 3, padding=1)
+            self.f1 = tnn.Linear(64 * 2 * 2, 128)
+            self.f2 = tnn.Linear(128, 10)
+
+        def forward(self, x):
+            import torch.nn.functional as F
+
+            x = F.max_pool2d(F.relu(self.c1(x)), 2)
+            x = F.max_pool2d(F.relu(self.c2(x)), 2)
+            return self.f2(F.relu(self.f1(x.flatten(1))))
+
+    return DigitsNet()
+
+
+def torch_tta(goal_acc: float = GOAL_ACC_PCT, max_epochs: int = MAX_EPOCHS,
+              batch: int = BATCH, lr: float = LR, seed: int = 0) -> Dict:
+    """Plain torch training to goal accuracy on the real digits corpus."""
+    import torch
+
+    from .scenarios import load_digits_real
+
+    xtr, ytr, xte, yte = load_digits_real()
+    # NCHW, same /16 scaling the framework's preprocess applies on device
+    xtr_t = torch.tensor(xtr.astype(np.float32).transpose(0, 3, 1, 2) / 16.0)
+    ytr_t = torch.tensor(ytr)
+    xte_t = torch.tensor(xte.astype(np.float32).transpose(0, 3, 1, 2) / 16.0)
+    yte_t = torch.tensor(yte)
+
+    torch.manual_seed(seed)
+    dev = torch.device("cuda" if torch.cuda.is_available() else "cpu")
+    model = _torch_digitsnet().to(dev)
+    opt = torch.optim.SGD(model.parameters(), lr=lr, momentum=0.9)
+    loss_fn = torch.nn.CrossEntropyLoss()
+    g = np.random.default_rng(seed)
+
+    accs: List[float] = []
+    epoch_seconds: List[float] = []
+    t_goal: Optional[float] = None
+    total = 0.0
+    for epoch in range(max_epochs):
+        t0 = time.perf_counter()
+        model.train()
+        order = g.permutation(len(xtr_t))
+        for i in range(0, len(order), batch):
+            idx = order[i:i + batch]
+            opt.zero_grad(set_to_none=True)
+            loss = loss_fn(model(xtr_t[idx].to(dev)), ytr_t[idx].to(dev))
+            loss.backward()
+            opt.step()
+        model.eval()
+        with torch.no_grad():
+            pred = model(xte_t.to(dev)).argmax(dim=1).cpu()
+        acc = float((pred == yte_t).float().mean()) * 100.0
+        dt = time.perf_counter() - t0
+        total += dt
+        accs.append(round(acc, 2))
+        epoch_seconds.append(round(dt, 3))
+        if acc >= goal_acc:
+            t_goal = total
+            break
+
+    import torch as _t
+
+    return {
+        "system": f"torch-{_t.__version__} ({dev})",
+        "corpus": "sklearn digits (real, 1437/360 split)",
+        "goal_acc_pct": goal_acc,
+        "seconds_to_goal": round(t_goal, 2) if t_goal is not None else None,
+        "epochs_to_goal": len(accs) if t_goal is not None else None,
+        "accuracy": accs,
+        "epoch_seconds": epoch_seconds,
+        "batch": batch, "lr": lr,
+    }
+
+
+def framework_tta(goal_acc: float = GOAL_ACC_PCT, config=None) -> Dict:
+    """The digits-real scenario through the live control plane, stopped at
+    ``goal_acc`` — the framework's own TTA including every overhead."""
+    import tempfile
+    from pathlib import Path
+
+    from ..api.config import Config
+    from .scenarios import ExperimentDriver, scenarios
+
+    sc = next(s for s in scenarios() if s.name == "digits-real")
+    sc.request.options.goal_accuracy = goal_acc
+    sc.request.epochs = MAX_EPOCHS
+
+    tmp = None
+    if config is None:
+        tmp = tempfile.TemporaryDirectory(prefix="kubeml-tta-")
+        config = Config(data_root=Path(tmp.name))
+    try:
+        with ExperimentDriver(config) as d:
+            res = d.run(sc, quick=False)
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+    reached = [i for i, a in enumerate(res.accuracy) if a >= goal_acc]
+    secs = (round(sum(res.epoch_seconds[: reached[0] + 1]), 2)
+            if reached else None)
+    import jax
+
+    return {
+        "system": f"kubeml-tpu K-AVG p=2 K=8 ({jax.default_backend()})",
+        "corpus": "sklearn digits (real, 1437/360 split)",
+        "goal_acc_pct": goal_acc,
+        "seconds_to_goal": secs,
+        "epochs_to_goal": reached[0] + 1 if reached else None,
+        "accuracy": res.accuracy,
+        "epoch_seconds": [round(s, 3) for s in res.epoch_seconds],
+        "batch": sc.request.batch_size, "lr": sc.request.lr,
+        "status": res.status, "error": res.error,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--goal", type=float, default=GOAL_ACC_PCT)
+    ap.add_argument("--out", type=str, default=None)
+    args = ap.parse_args(argv)
+
+    rows = [framework_tta(args.goal), torch_tta(args.goal)]
+    a, b = rows[0]["seconds_to_goal"], rows[1]["seconds_to_goal"]
+    summary = {
+        "metric": "digits-real-time-to-accuracy",
+        "goal_acc_pct": args.goal,
+        "framework_seconds": a,
+        "torch_seconds": b,
+        "speedup_vs_torch": round(b / a, 3) if a and b else None,
+        "note": "same corpus, same split, same host; framework side includes "
+                "the full control plane (scheduler+PS+K-AVG engine)",
+    }
+    for r in rows:
+        print(json.dumps(r))
+    print(json.dumps(summary))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"rows": rows, "summary": summary}, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,65 @@
+"""Measured torch comparator (VERDICT r2 #4: vs_baseline must divide by a
+measured same-architecture figure, not an assumed constant)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from kubeml_tpu.benchmarks.comparator import _FACTORIES, measure
+
+
+def test_measure_lenet_returns_provenance():
+    row = measure("lenet-mnist", batch=8, steps=2, warmup=1)
+    assert row["samples_per_sec"] > 0
+    for key in ("framework", "device", "batch", "steps", "method",
+                "measured_at"):
+        assert row[key], key
+    assert row["framework"].startswith("torch-")
+
+
+def test_torch_mirrors_match_flax_param_counts():
+    """The comparator only measures something meaningful if the torch model
+    IS the flax flagship — same parameter count, layer for layer."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeml_tpu.models.lenet import LeNet
+    from kubeml_tpu.models.resnet import ResNet18
+
+    flax_counts = {}
+    for name, (module, shape) in {
+        "lenet-mnist": (LeNet(num_classes=10), (1, 28, 28, 1)),
+        "resnet18-cifar10": (ResNet18(num_classes=10), (1, 32, 32, 3)),
+    }.items():
+        variables = module.init(jax.random.PRNGKey(0), jnp.zeros(shape))
+        flax_counts[name] = sum(
+            int(np.prod(v.shape)) for v in jax.tree.leaves(variables["params"])
+        )
+
+    for name, (factory, _) in _FACTORIES.items():
+        tmodel = factory(10)
+        # BatchNorm: flax counts scale+bias in params (means/vars live in
+        # batch_stats); torch's running stats are buffers, not parameters —
+        # so named_parameters() is the comparable set
+        tcount = sum(p.numel() for p in tmodel.parameters())
+        assert tcount == flax_counts[name], (
+            f"{name}: torch {tcount} != flax {flax_counts[name]}"
+        )
+
+
+def test_baseline_for_prefers_measured(tmp_path, monkeypatch):
+    from kubeml_tpu.benchmarks import comparator, harness
+
+    monkeypatch.setattr(comparator, "_results_dir", lambda: tmp_path)
+    monkeypatch.setattr(
+        comparator, "measure",
+        lambda name, batch=128, **kw: {"model": name, "samples_per_sec": 123.4,
+                                       "method": "stub"},
+    )
+    fs = harness.flagship()
+    sps, row = harness.baseline_for(fs)
+    assert sps == 123.4
+    assert row["method"] == "stub"
+    # and the measurement was cached
+    assert (tmp_path / f"comparator_{fs.name}.json").exists()
