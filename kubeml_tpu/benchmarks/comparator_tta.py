@@ -172,14 +172,28 @@ def main(argv=None) -> int:
 
     rows = [framework_tta(args.goal), torch_tta(args.goal)]
     a, b = rows[0]["seconds_to_goal"], rows[1]["seconds_to_goal"]
+    # steady-state epoch rate excludes the one-time jit compile that dominates
+    # the framework's first epoch at this TINY scale (1,437 8x8 images);
+    # with only one epoch run there is no compile-free sample -> None
+    steady = [
+        min(r["epoch_seconds"][1:]) if len(r["epoch_seconds"]) > 1 else None
+        for r in rows
+    ]
     summary = {
         "metric": "digits-real-time-to-accuracy",
         "goal_acc_pct": args.goal,
         "framework_seconds": a,
         "torch_seconds": b,
         "speedup_vs_torch": round(b / a, 3) if a and b else None,
+        "framework_steady_epoch_s": steady[0],
+        "torch_steady_epoch_s": steady[1],
         "note": "same corpus, same split, same host; framework side includes "
-                "the full control plane (scheduler+PS+K-AVG engine)",
+                "the full control plane (scheduler+PS+K-AVG engine). At this "
+                "toy scale fixed overheads (one ~5s jit compile, worker "
+                "staging) dominate and plain torch wins on a CPU host — the "
+                "number to read is the trend at scale: the throughput "
+                "comparator (comparator.py) and the on-chip tables in "
+                "BASELINE.md are the at-scale story",
     }
     for r in rows:
         print(json.dumps(r))

@@ -60,11 +60,18 @@ def baseline_for(fs: Flagship) -> Tuple[float, dict]:
 def flagship(dtype=None) -> Flagship:
     """The headline benchmark model: ResNet-18/CIFAR-10 when the resnet family
     is available (BASELINE.md target #2), else LeNet/MNIST (target #1).
+    ``KUBEML_FLAGSHIP=lenet`` forces the light flagship — a diagnostic knob
+    (e.g. driving the full bench body on a CPU dev box, where the ResNet
+    round is minutes of compute per rep).
 
     ``dtype`` selects the computation precision (e.g. ``jnp.bfloat16`` for the
     MXU's native mixed-precision passes); None = model default (f32)."""
+    import os
+
     kw = {} if dtype is None else {"dtype": dtype}
     try:
+        if os.environ.get("KUBEML_FLAGSHIP", "").lower() == "lenet":
+            raise ImportError("KUBEML_FLAGSHIP=lenet")
         from ..models.resnet import ResNet18
 
         return Flagship(

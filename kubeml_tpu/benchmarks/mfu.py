@@ -35,37 +35,87 @@ _PEAKS = {
     "v2": 45e12,
 }
 
+# published HBM bandwidth (bytes/s) per chip, same keying
+_BWS = {
+    "v5 lite": 819e9,   # TPU v5e: 16 GB HBM2 @ 819 GB/s
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v4": 1228e9,
+    "v6 lite": 1640e9,
+    "v6e": 1640e9,
+    "v3": 900e9,
+    "v2": 700e9,
+}
 
-def peak_flops(device: Optional[jax.Device] = None) -> Optional[float]:
-    """bf16 peak FLOP/s of one chip; None when unknown (MFU then unreported)."""
-    env = os.environ.get("KUBEML_PEAK_FLOPS")
+
+def _device_spec(table: dict, env_var: str, env_scale: float,
+                 device: Optional[jax.Device]) -> Optional[float]:
+    """Env override, else device_kind marker scan over ``table``."""
+    env = os.environ.get(env_var)
     if env:
-        return float(env) * 1e12
+        return float(env) * env_scale
     device = device or jax.devices()[0]
     kind = getattr(device, "device_kind", "").lower()
-    for marker, peak in _PEAKS.items():
+    for marker, value in table.items():
         if marker in kind:
-            return peak
+            return value
     return None
 
 
-def compiled_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
-    """FLOPs of one invocation, from the compiled executable's cost analysis.
+def peak_flops(device: Optional[jax.Device] = None) -> Optional[float]:
+    """bf16 peak FLOP/s of one chip; None when unknown (MFU then unreported).
+    Override with ``KUBEML_PEAK_FLOPS`` in TFLOP/s."""
+    return _device_spec(_PEAKS, "KUBEML_PEAK_FLOPS", 1e12, device)
 
-    CAVEAT: XLA counts a ``lax.while``/``lax.scan`` body ONCE regardless of
-    trip count (verified on v5e) — for programs with a scanned hot loop use a
-    1-step variant and scale (see ``KAvgTrainer.round_flops``).
 
-    Lowering again for an already-jitted function hits the in-memory/persistent
-    compile cache, so this is cheap to call after the benchmark ran."""
+def hbm_bandwidth(device: Optional[jax.Device] = None) -> Optional[float]:
+    """HBM bandwidth (bytes/s) of one chip; None when unknown.
+    Override with ``KUBEML_HBM_BW`` in GB/s."""
+    return _device_spec(_BWS, "KUBEML_HBM_BW", 1e9, device)
+
+
+def roofline_mfu(flops: Optional[float], bytes_accessed: Optional[float],
+                 device: Optional[jax.Device] = None) -> Optional[float]:
+    """The MFU CEILING the classic roofline model allows this program:
+
+        intensity = flops / bytes_accessed          (FLOPs per HBM byte)
+        ceiling   = min(peak, intensity * HBM_BW) / peak
+
+    A measured MFU near this ceiling means the program is BANDWIDTH-bound and
+    no kernel tuning will push utilization past it — the lever is arithmetic
+    intensity (bigger batch, fusion, lower-precision activations). Far below
+    the ceiling means compute-side headroom (gaps, small matmuls, dispatch).
+    bytes_accessed comes from the same XLA cost analysis as the FLOPs, so
+    this is the compiler's own accounting, not an analytic guess."""
+    peak = peak_flops(device)
+    bw = hbm_bandwidth(device)
+    if not flops or not bytes_accessed or not peak or not bw:
+        return None
+    return min(peak, (flops / bytes_accessed) * bw) / peak
+
+
+def compiled_costs(jitted_fn, *args, **kwargs) -> dict:
+    """{'flops': ..., 'bytes_accessed': ...} of one invocation from the
+    compiled executable's cost analysis (either may be absent -> None).
+    Same lax.scan caveat as ``compiled_flops``."""
+    out = {"flops": None, "bytes_accessed": None}
     try:
         analysis = jitted_fn.lower(*args, **kwargs).compile().cost_analysis()
         if isinstance(analysis, (list, tuple)):
             analysis = analysis[0]
         flops = float(analysis.get("flops", 0.0))
-        return flops if flops > 0 else None
+        out["flops"] = flops if flops > 0 else None
+        by = float(analysis.get("bytes accessed", 0.0))
+        out["bytes_accessed"] = by if by > 0 else None
     except Exception:
-        return None
+        pass
+    return out
+
+
+def compiled_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
+    """FLOPs of one invocation — the flops view of ``compiled_costs`` (same
+    lax.scan caveat; lowering an already-jitted fn hits the compile cache)."""
+    return compiled_costs(jitted_fn, *args, **kwargs)["flops"]
 
 
 def mfu_from(flops_per_step: Optional[float], steps_per_sec: float,

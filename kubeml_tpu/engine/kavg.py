@@ -628,14 +628,21 @@ class KAvgTrainer:
 
     def round_flops(self, stacked_vars, x, y, mask, lr: float,
                     epoch: int = 0) -> Optional[float]:
-        """FLOPs of one sync round, from XLA's own cost analysis.
+        """FLOPs of one sync round (see ``round_costs``)."""
+        return self.round_costs(stacked_vars, x, y, mask, lr, epoch)["flops"]
+
+    def round_costs(self, stacked_vars, x, y, mask, lr: float,
+                    epoch: int = 0) -> dict:
+        """{'flops', 'bytes_accessed'} of one sync round, from XLA's own cost
+        analysis (either may be None).
 
         XLA counts a ``lax.scan`` body ONCE regardless of trip count (verified
         on v5e: identical totals for k=1/2/8), so this lowers a 1-step variant
         of the program and scales by k — robust even if a future XLA starts
         multiplying by the (static) trip count, since a 1-step program is the
         same either way. The merge's own FLOPs (~3 x params) are counted k
-        times; negligible against the conv/matmul body."""
+        times; negligible against the conv/matmul body. ``bytes_accessed``
+        feeds the roofline ceiling (benchmarks.mfu.roofline_mfu)."""
         n, k = x.shape[0], x.shape[1]
         fn1 = self._build_sync_round(n, 1, float(lr), int(epoch))
         sharded, replicated = self._shardings(n)
@@ -652,10 +659,14 @@ class KAvgTrainer:
         wm = sds((n,), jnp.float32, replicated)
         rng_ex = jax.random.PRNGKey(0)
         rngs = sds(rng_ex.shape, rng_ex.dtype, replicated)
-        from ..benchmarks.mfu import compiled_flops
+        from ..benchmarks.mfu import compiled_costs
 
-        flops = compiled_flops(fn1, vars_spec, x1, y1, m1, wm, rngs)
-        return flops * k if flops is not None else None
+        costs = compiled_costs(fn1, vars_spec, x1, y1, m1, wm, rngs)
+        return {
+            "flops": costs["flops"] * k if costs["flops"] is not None else None,
+            "bytes_accessed": (costs["bytes_accessed"] * k
+                               if costs["bytes_accessed"] is not None else None),
+        }
 
     # --- validation / inference ---
 

@@ -1,0 +1,52 @@
+"""MFU / roofline accounting (benchmarks.mfu + KAvgTrainer.round_costs)."""
+
+import jax
+import numpy as np
+import pytest
+
+from kubeml_tpu.benchmarks.mfu import mfu_from, roofline_mfu
+
+
+def test_roofline_mfu_math(monkeypatch):
+    # peak 100 GFLOP/s, HBM 10 GB/s (env overrides are in TFLOP/s and GB/s)
+    monkeypatch.setenv("KUBEML_PEAK_FLOPS", "0.1")
+    monkeypatch.setenv("KUBEML_HBM_BW", "10")
+    # intensity 5 flops/byte -> 5 * 10e9 = 50 GFLOP/s achievable -> 0.5 ceiling
+    assert roofline_mfu(flops=5e9, bytes_accessed=1e9) == pytest.approx(0.5)
+    # intensity high enough to hit the compute peak -> ceiling 1.0
+    assert roofline_mfu(flops=1e12, bytes_accessed=1e9) == pytest.approx(1.0)
+    assert roofline_mfu(None, 1e9) is None
+    assert roofline_mfu(1e9, None) is None
+
+
+def test_mfu_from_env_peak(monkeypatch):
+    monkeypatch.setenv("KUBEML_PEAK_FLOPS", "1")  # 1 TFLOP/s
+    assert mfu_from(5e11, 1.0) == pytest.approx(0.5)
+    assert mfu_from(None, 1.0) is None
+
+
+@pytest.mark.slow
+def test_round_costs_reports_flops_and_bytes():
+    """The compiler's cost analysis must yield BOTH axes of the roofline for
+    the real sync-round program (CPU backend also reports them)."""
+    from kubeml_tpu.benchmarks.harness import make_synthetic_model
+    from kubeml_tpu.engine.kavg import KAvgTrainer
+    from kubeml_tpu.models.lenet import LeNet
+
+    model = make_synthetic_model(LeNet(num_classes=10), "mfu-test")
+    trainer = KAvgTrainer(model, precision="f32")
+    r = np.random.default_rng(0)
+    n, k, b = 2, 2, 8
+    x = r.normal(size=(n, k, b, 28, 28, 1)).astype(np.float32)
+    y = r.integers(0, 10, size=(n, k, b)).astype(np.int64)
+    mask = np.ones((n, k, b), np.float32)
+    variables = trainer.init_variables(jax.random.PRNGKey(0), x[0, 0], n)
+
+    costs = trainer.round_costs(variables, x, y, mask, lr=0.1)
+    assert costs["flops"] and costs["flops"] > 0
+    assert costs["bytes_accessed"] and costs["bytes_accessed"] > 0
+    # k scaling: the k-step round must cost k x the 1-step program
+    k1 = trainer.round_costs(variables, x[:, :1], y[:, :1], mask[:, :1], lr=0.1)
+    assert costs["flops"] == pytest.approx(k1["flops"] * k)
+    # round_flops stays the flops view of the same analysis
+    assert trainer.round_flops(variables, x, y, mask, lr=0.1) == costs["flops"]
