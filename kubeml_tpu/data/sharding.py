@@ -65,10 +65,34 @@ class RoundPlan:
     worker_ranges: List[Tuple[int, int]]  # contiguous doc ranges
     num_rounds: int
     steps_per_round: int  # uniform across rounds/workers (padding fills the tail)
+    # true dataset length (the last doc may be partial); num_docs*subset_size
+    # when the caller didn't know better
+    num_samples: int = 0
 
     @property
     def samples_per_worker_round(self) -> int:
         return self.steps_per_round * self.batch_size
+
+    def worker_samples(self) -> List[int]:
+        """Real (unpadded) sample count of each worker's shard."""
+        cap = self.num_samples or self.num_docs * self.subset_size
+        return [
+            max(0, min(e * self.subset_size, cap) - s * self.subset_size)
+            for s, e in self.worker_ranges
+        ]
+
+    def data_bearing(self, round_index: int) -> "np.ndarray":
+        """[n_workers] bool: which workers have ANY real sample in this round.
+
+        Pure plan math — identical on every host regardless of which
+        worker-rows block it materializes (multi-host chaos decisions must
+        agree across processes without seeing other hosts' slabs)."""
+        import numpy as np
+
+        spr = self.samples_per_worker_round
+        return np.asarray(
+            [ws > round_index * spr for ws in self.worker_samples()], bool
+        )
 
 
 def plan_epoch(
@@ -118,6 +142,7 @@ def plan_epoch(
         worker_ranges=ranges,
         num_rounds=num_rounds,
         steps_per_round=steps,
+        num_samples=num_samples,
     )
 
 
@@ -152,4 +177,5 @@ def plan_eval(
         worker_ranges=ranges,
         num_rounds=num_rounds,
         steps_per_round=steps,
+        num_samples=num_samples,
     )

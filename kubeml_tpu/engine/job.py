@@ -84,12 +84,13 @@ class TrainJob:
             dist = get_dist_context()
         self.dist = dist
         self._leader = dist is None or dist.is_leader
-        if dist is not None and dist.size > 1:
-            if chaos is not None or request.options.chaos_prob > 0.0:
-                # chaos masks would have to be bit-identical on every process;
-                # keep fault injection a single-process testing tool
-                raise ValueError("fault injection is not supported in "
-                                 "multi-host mode")
+        if dist is not None and dist.size > 1 and chaos is not None:
+            # a CUSTOM injector object only exists in this process; the
+            # option-derived injector below is deterministic from the job id,
+            # so chaos_prob works multi-host (every process draws identical
+            # masks in lockstep — no broadcast needed)
+            raise ValueError("custom chaos injectors are single-process "
+                             "only; use options.chaos_prob in multi-host mode")
 
         self.parallelism = request.options.default_parallelism
         self._pending_notes: list = []
@@ -110,9 +111,14 @@ class TrainJob:
             donate=request.options.donate, mesh_shape=request.options.mesh_shape,
             dist=dist,
         )
-        # fault injection + health-based re-meshing (SURVEY §5/§7)
+        # fault injection + health-based re-meshing (SURVEY §5/§7). The seed
+        # derives from the JOB ID, not the per-process seed arg: in multi-host
+        # mode every process must draw bit-identical masks in lockstep
         if chaos is None and request.options.chaos_prob > 0.0:
-            chaos = FailureInjector(prob=request.options.chaos_prob, seed=seed)
+            import zlib
+
+            chaos = FailureInjector(prob=request.options.chaos_prob,
+                                    seed=zlib.crc32(job_id.encode()) & 0x7FFFFFFF)
         self.chaos = chaos
         self.health = WorkerHealth(threshold=health_threshold)
         self.tracer = get_tracer()
@@ -193,6 +199,15 @@ class TrainJob:
                 # the epoch boundary — the collective can't drop them mid-round
                 if not opts.static_parallelism:
                     healthy_p = self.health.suggest_parallelism(self.parallelism)
+                    if self.dist is not None and self.dist.size > 1:
+                        # worker axis must stay a host-count multiple (same
+                        # invariant the constructor and the elastic branch
+                        # enforce); health state is lockstep-identical on
+                        # every process, so each computes the same rounding
+                        healthy_p = max(
+                            self.dist.size,
+                            (healthy_p // self.dist.size) * self.dist.size,
+                        )
                     if healthy_p < self.parallelism:
                         log.warning(
                             "%s: %d persistently failed worker(s); re-meshing %d -> %d",
@@ -399,8 +414,11 @@ class TrainJob:
                 # the host knows both masks: when chaos leaves no healthy
                 # data-bearing worker, skip the round here (weights keep their
                 # pre-round value) instead of running a no-participant merge —
-                # so a NaN loss from the device always means real divergence
-                data_bearing = rb.mask.reshape(self.parallelism, -1).sum(axis=1) > 0
+                # so a NaN loss from the device always means real divergence.
+                # data-bearing comes from PLAN math, not rb.mask: in dist mode
+                # each host materializes only its worker-rows block, and the
+                # skip decision must be identical on every process
+                data_bearing = loader.plan.data_bearing(rb.round_index)
                 if float((worker_mask * data_bearing).sum()) == 0.0:
                     skipped += 1
                     log.warning("%s: round %d skipped — no healthy data-bearing worker",

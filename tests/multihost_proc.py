@@ -166,6 +166,59 @@ def _run_infer_mode(cluster, result) -> None:
     )
 
 
+def _run_chaos_mode(cluster, result) -> None:
+    """K-AVG job WITH fault injection across hosts: every process draws
+    bit-identical chaos masks (job-id-seeded, lockstep) so the collective
+    programs never diverge — multi-host chaos was a hard ValueError before."""
+    import numpy as np
+
+    from kubeml_tpu.api.types import JobState, TrainOptions, TrainRequest, TrainTask
+
+    src = (
+        "import optax\n"
+        "from kubeml_tpu.data.dataset import KubeDataset\n"
+        "from kubeml_tpu.models.lenet import LeNet\n"
+        "from kubeml_tpu.runtime.model import KubeModel\n"
+        "class DS(KubeDataset):\n"
+        "    def __init__(self):\n"
+        "        super().__init__('digits')\n"
+        "class Model(KubeModel):\n"
+        "    def __init__(self):\n"
+        "        super().__init__(DS())\n"
+        "    def build(self):\n"
+        "        return LeNet(num_classes=10)\n"
+        "    def preprocess(self, x):\n"
+        "        return x.astype('float32') / 255.0\n"
+        "    def configure_optimizers(self):\n"
+        "        return optax.sgd(self.lr)\n"
+        "def main():\n"
+        "    return Model()\n"
+    )
+    cluster.registry.create("mhfn", src)
+    r = np.random.default_rng(0)
+    xtr = r.integers(0, 256, (512, 14, 14, 1), dtype=np.uint8)
+    ytr = (xtr.reshape(512, 14, 14).mean(axis=2).argmax(axis=1) % 10).astype(np.int64)
+    cluster.store.create("digits", xtr, ytr, xtr[:128], ytr[:128])
+
+    req = TrainRequest(
+        dataset="digits", function_name="mhfn", epochs=3, batch_size=16,
+        lr=0.05,
+        options=TrainOptions(default_parallelism=2, k=2, validate_every=1,
+                             static_parallelism=True, chaos_prob=0.25),
+    )
+    task = TrainTask(job_id="mhchaos1", parameters=req, state=JobState())
+    cluster.ps.start_task(task)
+    cluster.ps.wait(task.job_id, timeout=600)
+    hist = cluster.history_store.get(task.job_id)
+    error = hist.task.get("error") if isinstance(hist.task, dict) else None
+    result.update(
+        status=str(task.status),
+        epochs=len(hist.train_loss),
+        train_loss=hist.train_loss,
+        error=error,
+    )
+
+
 def main() -> int:
     rank = int(sys.argv[1])
     nprocs = int(sys.argv[2])
@@ -226,6 +279,9 @@ def main() -> int:
                 raise _Done
             if mode == "infer":
                 _run_infer_mode(cluster, result)
+                raise _Done
+            if mode == "chaos":
+                _run_chaos_mode(cluster, result)
                 raise _Done
             # deploy the function + synthetic dataset (both hosts read the
             # same data root, as a shared filesystem would provide)
