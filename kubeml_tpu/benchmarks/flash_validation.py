@@ -1,21 +1,19 @@
 """On-chip validation + re-measurement of the streaming flash kernels.
 
-The kernels were rewritten to stream K/V through a sequential grid axis
-(ops/flash_attention.py) — no sequence-length ceiling by design — but the dev
-TPU went down before the >8k regime could be re-measured, so auto-dispatch
-still caps at ``FLASH_MAX_KV_LEN = 8192``. This script is the one-command
-pending work for the next chip session:
+Round-3 status: RAN AND PASSED on the chip (2026-07-31) — gradient parity
+<= 4.9e-3, 16k forward+backward compile in seconds, and the tuned kernels
+(bf16 MXU matmuls, 512x1024 blocks, causal copy-skip clamp) beat same-day
+XLA at every length, so ``FLASH_MAX_KV_LEN`` is now None and the
+auto-dispatch threshold is 1024 (table: BASELINE.md long-context; raw rows:
+results/longcontext_r3_*.jsonl). The script remains the one-command
+revalidation harness for any future kernel change:
 
     python -m kubeml_tpu.benchmarks.flash_validation
 
 1. gradient parity vs the XLA oracle at L=512 (real Mosaic lowering);
 2. compile + run forward AND backward at L=16384 (the case the old
    whole-K/V-resident design could not compile);
-3. the long-context training rows at 4k/8k/16k with the cap lifted.
-
-If all three pass and 16k flash beats the recorded XLA fallback
-(17.9k tokens/sec), set ``kubeml_tpu.ops.attention.FLASH_MAX_KV_LEN = None``
-and refresh BASELINE.md's table from the printed rows.
+3. the long-context training rows at 4k/8k/16k with flash forced on.
 """
 
 from __future__ import annotations

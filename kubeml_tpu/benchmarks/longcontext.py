@@ -4,12 +4,12 @@ The reference has no long-context story at all (SURVEY §5: sequence length is
 never a concept). This benchmark measures the TPU-native one end-to-end: the
 causal-transformer flagship under the SPMD engine with rematerialized blocks
 (``jax.checkpoint``) and the Pallas flash-attention kernel auto-dispatched at
-KV length >= 4096 — measured 3.5-7x faster than XLA's fused attention inside
-the rematerialized training step at long context, though slower in isolation
-(the full measurement story lives in kubeml_tpu/ops/attention.py). Fixed token
-budget per step so throughput is comparable across sequence lengths.
+KV length >= 1024 — measured 1.2-21x faster than XLA's fused attention inside
+the rematerialized training step (round-3 table in BASELINE.md; the full
+measurement story lives in kubeml_tpu/ops/attention.py). Fixed token budget
+per step so throughput is comparable across sequence lengths.
 
-    python -m kubeml_tpu.benchmarks.longcontext                 # 1k..8k sweep
+    python -m kubeml_tpu.benchmarks.longcontext                 # 1k..16k sweep
     python -m kubeml_tpu.benchmarks.longcontext --seq-lens 4096 --steps 10
 
 Prints one JSON line per (seq_len, dtype): tokens/sec plus the config. On a
@@ -80,7 +80,8 @@ def run_point(seq_len: int, tokens_per_step: int, steps: int, dtype_name: str,
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="long-context LM training benchmark")
-    p.add_argument("--seq-lens", type=int, nargs="*", default=[1024, 2048, 4096, 8192])
+    p.add_argument("--seq-lens", type=int, nargs="*",
+                   default=[1024, 2048, 4096, 8192, 16384])
     p.add_argument("--tokens-per-step", type=int, default=16384,
                    help="fixed token budget per step (batch = budget // seq_len)")
     p.add_argument("--steps", type=int, default=5)

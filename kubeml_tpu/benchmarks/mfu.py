@@ -99,16 +99,20 @@ def compiled_costs(jitted_fn, *args, **kwargs) -> dict:
     compiled executable's cost analysis (either may be absent -> None).
     Same lax.scan caveat as ``compiled_flops``."""
     out = {"flops": None, "bytes_accessed": None}
-    try:
-        analysis = jitted_fn.lower(*args, **kwargs).compile().cost_analysis()
-        if isinstance(analysis, (list, tuple)):
-            analysis = analysis[0]
-        flops = float(analysis.get("flops", 0.0))
-        out["flops"] = flops if flops > 0 else None
-        by = float(analysis.get("bytes accessed", 0.0))
-        out["bytes_accessed"] = by if by > 0 else None
-    except Exception:
-        pass
+    # two attempts: on the tunneled dev TPU the remote-compile RPC flakes
+    # occasionally, and a swallowed one-off turns a real MFU row into null
+    for attempt in range(2):
+        try:
+            analysis = jitted_fn.lower(*args, **kwargs).compile().cost_analysis()
+            if isinstance(analysis, (list, tuple)):
+                analysis = analysis[0]
+            flops = float(analysis.get("flops", 0.0))
+            out["flops"] = flops if flops > 0 else None
+            by = float(analysis.get("bytes accessed", 0.0))
+            out["bytes_accessed"] = by if by > 0 else None
+            break
+        except Exception:
+            continue
     return out
 
 

@@ -26,30 +26,27 @@ import jax.numpy as jnp
 # Auto-dispatch threshold for the Pallas flash kernel, tuned on the TRAINING
 # path on v5e with a reliable value-fetch barrier. Inside a full
 # rematerialized training step (GPT 8x512, jax.checkpoint, 16k-token steps)
-# XLA's fused attention wins at short context but collapses at long context —
-# remat recomputes the backward's attention and XLA then materializes the L^2
-# scores through HBM, while the flash kernels (Pallas forward AND the
-# FlashAttention-2 Pallas backward, ops/flash_attention.py) stream tiles in
-# VMEM. Measured end-to-end tokens/sec with the Pallas backward (2026-07-30,
-# /tmp command: python -m kubeml_tpu.benchmarks.longcontext with the
-# threshold forced per column; table in BASELINE.md), xla vs pallas:
-# L=1024: 142k/127k, L=2048: 99k/96k, L=4096: 15.4k/59.0k (3.8x),
-# L=8192: 4.1k/34.9k (8.6x). Structured-mask callers at KV length >= this
-# threshold get the kernel; None disables.
-FLASH_MIN_KV_LEN = 4096
+# the streaming kernels (Pallas forward AND the FlashAttention-2 Pallas
+# backward, ops/flash_attention.py) now win at EVERY measured length after
+# the round-3 tuning (bf16 MXU matmuls, 512x1024 blocks, causal copy-skip):
+# measured end-to-end tokens/sec 2026-07-31, same-day XLA vs pallas
+# (canonical rows: results/longcontext_r3_{xla,flash}.jsonl):
+# L=1024: 127.7k/152.7k, L=2048: 92.3k/144.2k, L=4096: 15.2k/119.0k (7.8x),
+# L=8192: 4.0k/84.3k (20.9x), L=16384: 18.2k/53.8k (3.0x), L=32768: XLA OOMs
+# (the bf16[8,32k,32k] scores want 16 GB HBM) vs 34.8k. Below 1024 the win is
+# unmeasured (ViT/BERT classifier shapes run 65-128 tokens where either path
+# is a rounding error of the step) so XLA keeps the short tail. Structured-
+# mask callers at KV length >= this threshold get the kernel; None disables.
+FLASH_MIN_KV_LEN = 1024
 
-# Upper auto-dispatch bound. History: the original kernels kept each
-# (batch, head)'s whole padded K/V resident in VMEM and stopped compiling
-# between L=8192 (measured good) and L=16384 (measured: remote compile
-# fails) on v5e; above the bound auto-dispatch falls back to XLA's
-# fused+remat path (measured 17.9k tokens/sec at L=16k). The kernels have
-# since been rewritten to STREAM K/V through a sequential grid axis (VMEM
-# use is O(block^2), no length ceiling by design — ops/flash_attention.py),
-# and the full interpret-mode numerics suite passes, but the >8k regime has
-# not been RE-MEASURED on the chip yet (the dev TPU went down mid-round), so
-# the conservative bound stays until the measurement exists. Lift by setting
-# None once >=16k compile+win is confirmed on hardware.
-FLASH_MAX_KV_LEN = 8192
+# Upper auto-dispatch bound — None since round 3: the streaming rewrite
+# (K/V through a sequential grid axis, VMEM O(block^2)) removed the length
+# ceiling by design, and the >=16k regime is now chip-MEASURED (see table
+# above: 2.9x XLA at 16k, only-survivor at 32k). The knob survives for
+# tests/rollback: the original whole-K/V-resident kernels stopped compiling
+# between 8k and 16k, and the dispatch gate that protected that ceiling is
+# still exercised by test_dispatch_caps_at_max_kv_len.
+FLASH_MAX_KV_LEN = None
 
 
 def dot_product_attention(

@@ -237,6 +237,32 @@ def test_dispatch_caps_at_max_kv_len(rng, monkeypatch):
     assert out.shape == q.shape
 
 
+def test_default_dispatch_covers_16k_and_beyond(rng, monkeypatch):
+    """With the round-3 DEFAULT config (no monkeypatched thresholds) a 16k
+    structured-mask call must auto-dispatch to the flash kernel: the
+    chip-measured >=16k win removed FLASH_MAX_KV_LEN, and this pins the cap
+    from silently coming back."""
+    import sys
+
+    import kubeml_tpu.ops.attention as att
+
+    assert att.FLASH_MAX_KV_LEN is None
+    assert att.FLASH_MIN_KV_LEN is not None and att.FLASH_MIN_KV_LEN <= 16384
+
+    calls = {}
+
+    def fake_flash(q, k, v, causal=False, kv_valid=None):
+        calls["kv_len"] = k.shape[1]
+        return q
+
+    fa_mod = sys.modules["kubeml_tpu.ops.flash_attention"]
+    monkeypatch.setattr(fa_mod, "flash_attention", fake_flash)
+    monkeypatch.setattr(att.jax, "default_backend", lambda: "tpu")
+    q, k, v = qkv(rng, b=1, l=16384, h=1, d=8)
+    att.dot_product_attention(q, k, v, causal=True)
+    assert calls.get("kv_len") == 16384
+
+
 def test_flash_streaming_many_kv_blocks(rng):
     """Deep kv-stream coverage: 32 kv grid steps per q block (L=256, block 8
     in interpret mode) through forward AND backward — the carry
