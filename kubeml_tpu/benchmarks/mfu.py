@@ -86,7 +86,13 @@ def roofline_mfu(flops: Optional[float], bytes_accessed: Optional[float],
     intensity (bigger batch, fusion, lower-precision activations). Far below
     the ceiling means compute-side headroom (gaps, small matmuls, dispatch).
     bytes_accessed comes from the same XLA cost analysis as the FLOPs, so
-    this is the compiler's own accounting, not an analytic guess."""
+    this is the compiler's own accounting, not an analytic guess.
+
+    Caveat (measured, round 3): XLA counts bytes per op BEFORE fusion, so
+    the ceiling is CONSERVATIVE — for heavily-fused conv models the
+    overcount is big enough that measured MFU can exceed it (ViT-Tiny:
+    24.6% measured vs a 12.1% "ceiling"). Trust the ceiling only when it
+    sits well above the measured value; see BASELINE.md."""
     peak = peak_flops(device)
     bw = hbm_bandwidth(device)
     if not flops or not bytes_accessed or not peak or not bw:
