@@ -32,7 +32,7 @@ import numpy as np
 
 def run_point(seq_len: int, tokens_per_step: int, steps: int, dtype_name: str,
               depth: int = 8, embed_dim: int = 512, num_heads: int = 8,
-              vocab: int = 32000) -> dict:
+              vocab: int = 32000, logits_chunk: int | None = None) -> dict:
     from ..models.gpt import CausalTransformer
     from ..parallel.mesh import make_mesh
     from ..parallel.trainer import SPMDTrainer
@@ -44,7 +44,14 @@ def run_point(seq_len: int, tokens_per_step: int, steps: int, dtype_name: str,
         vocab_size=vocab, max_len=seq_len, embed_dim=embed_dim, depth=depth,
         num_heads=num_heads, mesh=mesh, remat=True, dtype=dtype,
     )
-    trainer = SPMDTrainer(module, mesh, precision="bf16")
+    if logits_chunk is None and seq_len > 32768:
+        # past 32k the [B, L, vocab] logits are the HBM wall (measured:
+        # 64k x 32k vocab = 8.4 GB f32 fails to fit with its backward copy,
+        # while 32k runs unchunked — the recorded 32k row stays reproducible);
+        # stream the lm_head + loss instead (parallel.trainer.chunked_lm_loss)
+        logits_chunk = 8192
+    trainer = SPMDTrainer(module, mesh, precision="bf16",
+                          logits_chunk=logits_chunk)
     r = np.random.default_rng(0)
     global_batch = batch * mesh.shape["dp"]
     tokens = r.integers(1, vocab, size=(global_batch, seq_len)).astype(np.int32)

@@ -11,6 +11,9 @@ _ZOO = {
     "VGG": "vgg", "VGG11": "vgg",
     "ViT": "vit", "ViTTiny": "vit",
     "BertBase": "bert", "BertClassifier": "bert", "BertTiny": "bert",
+    "CausalTransformer": "gpt", "GPTTiny": "gpt", "GPTSmall": "gpt",
+    "generate": "generation", "GenerateResult": "generation",
+    "init_cache": "generation",
 }
 
 __all__ = sorted(_ZOO)

@@ -42,9 +42,12 @@ class CausalSelfAttention(nn.Module):
     # kubeml_tpu.parallel.ulysses — needs the per-tp-shard head count,
     # num_heads/tp, divisible by sp)
     sp_impl: str = "ring"
+    # KV-cache capacity for autoregressive decode (models.generation); set by
+    # the parent from max_len. 0 = training/scoring only, no cache variables.
+    cache_len: int = 0
 
     @nn.compact
-    def __call__(self, x, valid):
+    def __call__(self, x, valid, decode: bool = False):
         if self.sp_impl not in ("ring", "ulysses"):
             raise ValueError(
                 f"unknown sp_impl {self.sp_impl!r} (valid: 'ring', 'ulysses')"
@@ -64,6 +67,39 @@ class CausalSelfAttention(nn.Module):
         k = heads(dense(H * D, (None, "tp"), "key")(x))
         v = heads(dense(H * D, (None, "tp"), "value")(x))
         out_proj = dense(E, ("tp", None), "proj")
+
+        if decode:
+            # KV-cache decode (models.generation): write this call's K/V at
+            # the cache cursor, attend q against the whole cache prefix. One
+            # code path serves prefill (L = prompt len, cursor 0) and the
+            # per-token steps (L = 1) — all shapes static, writes via
+            # dynamic_update_slice, so the step jits once and the cursor is
+            # a runtime scalar.
+            if self.cache_len <= 0:
+                raise ValueError("decode=True needs cache_len > 0 "
+                                 "(CausalTransformer sets it from max_len)")
+            if self.mesh is not None and self.mesh.shape.get("sp", 1) > 1:
+                raise ValueError("decode does not run under sequence "
+                                 "parallelism; use an sp=1 mesh for serving")
+            Lc = self.cache_len
+            ck = self.variable("cache", "k", jnp.zeros, (B, Lc, H, D), k.dtype)
+            cv = self.variable("cache", "v", jnp.zeros, (B, Lc, H, D), v.dtype)
+            cvalid = self.variable("cache", "valid", jnp.zeros, (B, Lc), jnp.bool_)
+            cursor = self.variable("cache", "index",
+                                   lambda: jnp.zeros((), jnp.int32))
+            i0 = cursor.value
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, i0, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, i0, 0, 0))
+            cvalid.value = jax.lax.dynamic_update_slice(
+                cvalid.value, valid.astype(jnp.bool_), (0, i0))
+            cursor.value = i0 + L
+            # [B, 1, L, Lc]: attend to written, valid cache slots at or before
+            # each query's absolute position i0 + l
+            k_pos = jnp.arange(Lc)[None, None, None, :]
+            q_pos = (i0 + jnp.arange(L))[None, None, :, None]
+            mask = cvalid.value[:, None, None, :] & (k_pos <= q_pos)
+            out = dot_product_attention(q, ck.value, cv.value, mask=mask)
+            return out_proj(out.reshape(B, L, H * D))
 
         if self.mesh is not None and self.mesh.shape.get("sp", 1) > 1:
             if self.sp_impl == "ulysses":
@@ -103,15 +139,17 @@ class GPTBlock(nn.Module):
     dtype: Any = jnp.float32
     ln_eps: float = 1e-6    # GPT-2 checkpoints use 1e-5
     attn_bias: bool = False
+    cache_len: int = 0
 
     @nn.compact
-    def __call__(self, x, valid, train: bool = False):
+    def __call__(self, x, valid, train: bool = False, decode: bool = False):
         y = nn.LayerNorm(name="ln1", dtype=jnp.float32,
                          epsilon=self.ln_eps)(x).astype(self.dtype)
         y = CausalSelfAttention(self.num_heads, mesh=self.mesh,
                                 sp_impl=self.sp_impl, dtype=self.dtype,
                                 use_bias=self.attn_bias,
-                                name="attn")(y, valid)
+                                cache_len=self.cache_len,
+                                name="attn")(y, valid, decode=decode)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(name="ln2", dtype=jnp.float32,
@@ -158,16 +196,40 @@ class CausalTransformer(nn.Module):
     top_k: int = 2
 
     @nn.compact
-    def __call__(self, token_ids, train: bool = False):
+    def __call__(self, token_ids, train: bool = False, decode: bool = False,
+                 return_hidden: bool = False):
         token_ids = token_ids.astype(jnp.int32)
         B, L = token_ids.shape
-        valid = token_ids != PAD_ID
+        if decode:
+            # Decode trusts every input token as real: prompts must be dense
+            # (models.generation's contract) and the sampling loop may
+            # legitimately emit id 0 (a live vocab token in e.g. GPT-2) —
+            # deriving validity from != PAD_ID here would silently drop such
+            # tokens from the cache's attention window.
+            valid = jnp.ones((B, L), jnp.bool_)
+        else:
+            valid = token_ids != PAD_ID
         x = nn.Embed(self.vocab_size, self.embed_dim, name="token_embed",
                      embedding_init=_part((None, "tp"))(nn.initializers.normal(0.02)))(token_ids)
         pos = self.param("pos_embed",
                          _part((None, None, "tp"))(nn.initializers.normal(0.02)),
                          (1, self.max_len, self.embed_dim))
-        x = (x + pos[:, :L]).astype(self.dtype)
+        if decode:
+            if self.moe_every > 0:
+                raise ValueError("KV-cache decode is dense-blocks only; "
+                                 "moe_every must be 0 for generation")
+            # absolute positions continue from the shared cache cursor (the
+            # per-layer attention caches keep their own identical copies; this
+            # one feeds the position embedding)
+            cursor = self.variable("cache", "index",
+                                   lambda: jnp.zeros((), jnp.int32))
+            i0 = cursor.value
+            cursor.value = i0 + L
+            pos_slice = jax.lax.dynamic_slice(
+                pos, (0, i0, 0), (1, L, self.embed_dim))
+            x = (x + pos_slice).astype(self.dtype)
+        else:
+            x = (x + pos[:, :L]).astype(self.dtype)
         for i in range(self.depth):
             if self.moe_every > 0 and (i + 1) % self.moe_every == 0:
                 from ..parallel.moe import MoEBlock
@@ -178,17 +240,29 @@ class CausalTransformer(nn.Module):
                              name=f"block_{i}")(x, valid, train=train)
             else:
                 # static_argnums counts self as 0, so `train` (a trace-time
-                # bool steering dropout determinism) is positional arg 3
+                # bool steering dropout determinism) is positional arg 3 and
+                # `decode` arg 4; decode never needs remat (no backward), so
+                # the remat wrapper only serves the training path
                 block_cls = (
-                    nn.remat(GPTBlock, static_argnums=(3,)) if self.remat else GPTBlock
+                    GPTBlock if decode or not self.remat
+                    else nn.remat(GPTBlock, static_argnums=(3, 4))
                 )
                 x = block_cls(self.num_heads, self.mlp_ratio, self.dropout,
                               mesh=self.mesh, sp_impl=self.sp_impl,
                               dtype=self.dtype, ln_eps=self.ln_eps,
                               attn_bias=self.attn_bias,
-                              name=f"block_{i}")(x, valid, train)
+                              cache_len=self.max_len if decode else 0,
+                              name=f"block_{i}")(x, valid, train, decode)
         x = nn.LayerNorm(name="ln_f", dtype=jnp.float32,
                          epsilon=self.ln_eps)(x).astype(self.dtype)
+        if return_hidden:
+            # final hidden states [B, L, E] for a chunked lm_head+loss
+            # (parallel.trainer.chunked_lm_loss): at very long context the
+            # full [B, L, vocab] logits tensor is the HBM wall AFTER flash
+            # attention removes the L^2 one (measured: L=64k x 32k vocab
+            # wants 8.4 GB f32), so the loss streams vocab chunks instead.
+            # lm_head params still exist (init runs with the default False).
+            return x
         logits = nn.Dense(self.vocab_size, name="lm_head", use_bias=False,
                           dtype=self.dtype,
                           kernel_init=_part((None, "tp"))(nn.initializers.lecun_normal()))(x)
