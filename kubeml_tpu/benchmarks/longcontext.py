@@ -72,7 +72,7 @@ def run_point(seq_len: int, tokens_per_step: int, steps: int, dtype_name: str,
         float(loss)  # reliable drain (see warmup note)
         dt = time.perf_counter() - t0
         best = max(best, steps * global_batch * seq_len / dt)
-    return {
+    row = {
         "metric": "gpt-longcontext-train-throughput",
         "seq_len": seq_len,
         "global_batch": global_batch,
@@ -83,6 +83,9 @@ def run_point(seq_len: int, tokens_per_step: int, steps: int, dtype_name: str,
         "unit": "tokens/sec",
         "loss": round(float(loss), 4),
     }
+    if logits_chunk is not None:  # provenance: the loss path differs
+        row["logits_chunk"] = logits_chunk
+    return row
 
 
 def main(argv=None) -> int:

@@ -45,6 +45,11 @@ class CausalSelfAttention(nn.Module):
     # KV-cache capacity for autoregressive decode (models.generation); set by
     # the parent from max_len. 0 = training/scoring only, no cache variables.
     cache_len: int = 0
+    # rotary position embeddings applied to q/k (ops.rotary): position enters
+    # the dot product as a phase, so there is no table and plain forward is
+    # not capped by max_len (the parent skips its learned pos_embed add)
+    rope: bool = False
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(self, x, valid, decode: bool = False):
@@ -88,6 +93,14 @@ class CausalSelfAttention(nn.Module):
             cursor = self.variable("cache", "index",
                                    lambda: jnp.zeros((), jnp.int32))
             i0 = cursor.value
+            if self.rope:
+                from ..ops.rotary import apply_rope
+
+                # keys are cached ALREADY rotated by their absolute position,
+                # so cached entries never need re-rotation as the cursor moves
+                pos = i0 + jnp.arange(L)
+                q = apply_rope(q, pos, self.rope_theta)
+                k = apply_rope(k, pos, self.rope_theta)
             ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, i0, 0, 0))
             cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, i0, 0, 0))
             cvalid.value = jax.lax.dynamic_update_slice(
@@ -100,6 +113,13 @@ class CausalSelfAttention(nn.Module):
             mask = cvalid.value[:, None, None, :] & (k_pos <= q_pos)
             out = dot_product_attention(q, ck.value, cv.value, mask=mask)
             return out_proj(out.reshape(B, L, H * D))
+
+        if self.rope:
+            from ..ops.rotary import apply_rope
+
+            pos = jnp.arange(L)
+            q = apply_rope(q, pos, self.rope_theta)
+            k = apply_rope(k, pos, self.rope_theta)
 
         if self.mesh is not None and self.mesh.shape.get("sp", 1) > 1:
             if self.sp_impl == "ulysses":
@@ -140,6 +160,8 @@ class GPTBlock(nn.Module):
     ln_eps: float = 1e-6    # GPT-2 checkpoints use 1e-5
     attn_bias: bool = False
     cache_len: int = 0
+    rope: bool = False
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(self, x, valid, train: bool = False, decode: bool = False):
@@ -149,6 +171,7 @@ class GPTBlock(nn.Module):
                                 sp_impl=self.sp_impl, dtype=self.dtype,
                                 use_bias=self.attn_bias,
                                 cache_len=self.cache_len,
+                                rope=self.rope, rope_theta=self.rope_theta,
                                 name="attn")(y, valid, decode=decode)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -190,6 +213,11 @@ class CausalTransformer(nn.Module):
     # --- HF GPT-2 compatibility (kubeml_tpu.interop.import_hf_gpt2) ---
     ln_eps: float = 1e-6    # GPT-2 uses 1e-5
     attn_bias: bool = False
+    # --- positions: "learned" (GPT-2 style absolute table, capped at
+    # max_len) or "rope" (ops.rotary — no table; plain forward extrapolates
+    # past max_len, which then only gates the decode cache capacity) ---
+    pos: str = "learned"
+    rope_theta: float = 10000.0
     # --- MoE interleaving ---
     moe_every: int = 0
     num_experts: int = 8
@@ -209,25 +237,34 @@ class CausalTransformer(nn.Module):
             valid = jnp.ones((B, L), jnp.bool_)
         else:
             valid = token_ids != PAD_ID
+        if self.pos not in ("learned", "rope"):
+            raise ValueError(f"unknown pos {self.pos!r} (valid: 'learned', 'rope')")
+        use_rope = self.pos == "rope"
         x = nn.Embed(self.vocab_size, self.embed_dim, name="token_embed",
                      embedding_init=_part((None, "tp"))(nn.initializers.normal(0.02)))(token_ids)
-        pos = self.param("pos_embed",
-                         _part((None, None, "tp"))(nn.initializers.normal(0.02)),
-                         (1, self.max_len, self.embed_dim))
+        if not use_rope:
+            pos = self.param("pos_embed",
+                             _part((None, None, "tp"))(nn.initializers.normal(0.02)),
+                             (1, self.max_len, self.embed_dim))
         if decode:
             if self.moe_every > 0:
                 raise ValueError("KV-cache decode is dense-blocks only; "
                                  "moe_every must be 0 for generation")
             # absolute positions continue from the shared cache cursor (the
             # per-layer attention caches keep their own identical copies; this
-            # one feeds the position embedding)
+            # one feeds the position embedding / exists for parity under rope)
             cursor = self.variable("cache", "index",
                                    lambda: jnp.zeros((), jnp.int32))
             i0 = cursor.value
             cursor.value = i0 + L
-            pos_slice = jax.lax.dynamic_slice(
-                pos, (0, i0, 0), (1, L, self.embed_dim))
-            x = (x + pos_slice).astype(self.dtype)
+            if use_rope:
+                x = x.astype(self.dtype)  # position enters inside attention
+            else:
+                pos_slice = jax.lax.dynamic_slice(
+                    pos, (0, i0, 0), (1, L, self.embed_dim))
+                x = (x + pos_slice).astype(self.dtype)
+        elif use_rope:
+            x = x.astype(self.dtype)
         else:
             x = (x + pos[:, :L]).astype(self.dtype)
         for i in range(self.depth):
@@ -237,6 +274,7 @@ class CausalTransformer(nn.Module):
                 x = MoEBlock(self.num_heads, self.num_experts, self.mlp_ratio,
                              self.top_k, self.dropout, mesh=self.mesh,
                              sp_impl=self.sp_impl, dtype=self.dtype,
+                             rope=use_rope, rope_theta=self.rope_theta,
                              name=f"block_{i}")(x, valid, train=train)
             else:
                 # static_argnums counts self as 0, so `train` (a trace-time
@@ -252,6 +290,7 @@ class CausalTransformer(nn.Module):
                               dtype=self.dtype, ln_eps=self.ln_eps,
                               attn_bias=self.attn_bias,
                               cache_len=self.max_len if decode else 0,
+                              rope=use_rope, rope_theta=self.rope_theta,
                               name=f"block_{i}")(x, valid, train, decode)
         x = nn.LayerNorm(name="ln_f", dtype=jnp.float32,
                          epsilon=self.ln_eps)(x).astype(self.dtype)

@@ -1,0 +1,46 @@
+"""Rotary position embeddings (RoPE).
+
+Position enters attention by rotating each (q, k) head-dim pair by an angle
+proportional to the token's absolute position, so relative offsets appear as
+phase differences inside the dot product — no learned position table, and
+sequence length is not capped by a table size (the learned ``pos_embed``
+path's ``max_len`` coupling). Applied to q/k BEFORE the attention call, so it
+composes unchanged with the XLA path, the Pallas flash kernels, and
+ring/ulysses sequence parallelism (each shard's rows carry their absolute
+rotation).
+
+TPU notes: the rotation is a pure elementwise op over [B, L, H, D] — XLA
+fuses it into the surrounding projections; angles are computed in f32
+regardless of the activation dtype (bf16 phases drift at long context).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_angles(positions: jnp.ndarray, head_dim: int,
+                theta: float = 10000.0) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(cos, sin) tables [..., L, head_dim/2] (f32) for absolute ``positions``."""
+    half = head_dim // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[..., None] * freqs  # [..., L, half]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
+               theta: float = 10000.0) -> jnp.ndarray:
+    """Rotate ``x`` [B, L, H, D] by its positions [L] or [B, L]; returns the
+    input dtype. Pairs are (x[..., :D/2], x[..., D/2:]) — the "rotate-half"
+    convention."""
+    b, l, h, d = x.shape
+    cos, sin = rope_angles(positions, d, theta)  # [..., L, D/2]
+    if cos.ndim == 2:  # positions were [L]
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    else:  # [B, L]
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    x1, x2 = x[..., : d // 2].astype(jnp.float32), x[..., d // 2:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)

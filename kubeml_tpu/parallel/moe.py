@@ -126,6 +126,8 @@ class MoEBlock(nn.Module):
     mesh: Optional[object] = None  # jax.sharding.Mesh; for sp attention
     sp_impl: str = "ring"
     dtype: object = jnp.float32  # computation dtype (router stays f32)
+    rope: bool = False  # rotary q/k (ops.rotary), forwarded by the parent
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(self, x, valid, train: bool = False):
@@ -134,6 +136,7 @@ class MoEBlock(nn.Module):
         y = nn.LayerNorm(name="ln1", dtype=jnp.float32)(x).astype(self.dtype)
         y = CausalSelfAttention(self.num_heads, mesh=self.mesh,
                                 sp_impl=self.sp_impl, dtype=self.dtype,
+                                rope=self.rope, rope_theta=self.rope_theta,
                                 name="attn")(y, valid)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y

@@ -1,0 +1,86 @@
+"""Rotary position embeddings (ops.rotary + the CausalTransformer pos="rope"
+path): the defining relative-position property, decode/cache parity, and the
+no-table extrapolation win over the learned pos_embed."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeml_tpu.models.generation import generate, init_cache
+from kubeml_tpu.models.gpt import CausalTransformer
+from kubeml_tpu.ops.rotary import apply_rope
+
+VOCAB = 89
+
+
+def test_rope_preserves_norm_and_relative_dots():
+    r = np.random.default_rng(0)
+    q = jnp.asarray(r.normal(size=(1, 6, 2, 8)), jnp.float32)
+    k = jnp.asarray(r.normal(size=(1, 6, 2, 8)), jnp.float32)
+    pos = jnp.arange(6)
+    qr, kr = apply_rope(q, pos), apply_rope(k, pos)
+    # rotation: norms unchanged
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(qr), axis=-1),
+                               np.linalg.norm(np.asarray(q), axis=-1),
+                               rtol=1e-5)
+    # the defining property: dot(q_i, k_j) depends only on (i - j) — shift
+    # every position by a constant and the attention scores must not move
+    qs, ks = apply_rope(q, pos + 13), apply_rope(k, pos + 13)
+    dots = lambda a, b: np.einsum("blhd,bmhd->bhlm", np.asarray(a), np.asarray(b))
+    np.testing.assert_allclose(dots(qs, ks), dots(qr, kr), rtol=1e-4, atol=1e-4)
+
+
+@pytest.fixture(scope="module")
+def rope_tiny():
+    module = CausalTransformer(vocab_size=VOCAB, max_len=24, embed_dim=32,
+                               depth=2, num_heads=2, pos="rope")
+    r = np.random.default_rng(1)
+    prompt = jnp.asarray(r.integers(1, VOCAB, size=(2, 7)), jnp.int32)
+    variables = module.init(jax.random.PRNGKey(0), prompt)
+    return module, variables, prompt
+
+
+def test_rope_has_no_pos_table(rope_tiny):
+    module, variables, _ = rope_tiny
+    assert "pos_embed" not in variables["params"]
+
+
+def test_rope_incremental_decode_matches_forward(rope_tiny):
+    module, variables, prompt = rope_tiny
+    full = module.apply(variables, prompt)
+    cache = init_cache(module, variables, prompt.shape[0])
+    outs = []
+    for t in range(prompt.shape[1]):
+        logits, vs = module.apply({**variables, "cache": cache},
+                                  prompt[:, t:t + 1], decode=True,
+                                  mutable=["cache"])
+        cache = vs["cache"]
+        outs.append(np.asarray(logits[:, 0]))
+    np.testing.assert_allclose(np.stack(outs, axis=1), np.asarray(full),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_rope_generates(rope_tiny):
+    module, variables, prompt = rope_tiny
+    out = generate(module, variables, prompt, max_new_tokens=4)
+    assert out.tokens.shape == (2, 4)
+    assert np.all(np.asarray(out.lengths) == 4)
+
+
+def test_rope_extrapolates_past_max_len(rope_tiny):
+    """No position table: plain forward accepts L > max_len (the learned
+    path shape-errors there), which is the point of shipping rope for the
+    long-context story."""
+    module, variables, _ = rope_tiny
+    r = np.random.default_rng(2)
+    long_tokens = jnp.asarray(r.integers(1, VOCAB, size=(1, 40)), jnp.int32)
+    logits = module.apply(variables, long_tokens)  # max_len is 24
+    assert logits.shape == (1, 40, VOCAB)
+    assert bool(jnp.isfinite(logits).all())
+
+    learned = CausalTransformer(vocab_size=VOCAB, max_len=24, embed_dim=32,
+                                depth=2, num_heads=2)
+    lv = learned.init(jax.random.PRNGKey(0), long_tokens[:, :8])
+    with pytest.raises(Exception):
+        learned.apply(lv, long_tokens)
