@@ -178,6 +178,31 @@ class InferRequest(_JsonMixin):
 
 
 @dataclass
+class GenerateRequest(_JsonMixin):
+    """Autoregressive sampling against a trained causal-LM job (extension —
+    the reference serves classifier forward passes only; this is the KV-cache
+    decode path, kubeml_tpu.models.generation)."""
+
+    model_id: str = ""
+    prompts: Any = None          # [B, Lp] int token ids (dense, no pad rows)
+    max_new_tokens: int = 32
+    temperature: float = 0.0     # 0 = greedy; > 0 requires an explicit seed
+    top_k: Optional[int] = None
+    eos_id: Optional[int] = None
+    seed: Optional[int] = None   # required when temperature > 0
+
+    def __post_init__(self):
+        if self.max_new_tokens <= 0:
+            raise ValueError("max_new_tokens must be positive")
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        if self.temperature > 0 and self.seed is None:
+            # mirrors models.generation.generate's rng guard: a silent default
+            # seed would return the identical "sample" on every request
+            raise ValueError("temperature > 0 requires an explicit seed")
+
+
+@dataclass
 class JobState(_JsonMixin):
     """Per-epoch state the job reports to the scheduler for re-evaluation of
     parallelism (reference: ml/pkg/api/types.go:68-71)."""

@@ -100,6 +100,23 @@ def cmd_infer(args) -> int:
     return 0
 
 
+def cmd_generate(args) -> int:
+    import numpy as np
+
+    prompts = np.load(args.datafile, allow_pickle=False)
+    out = _client(args).networks().generate(
+        args.network, prompts, max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature, top_k=args.top_k, eos_id=args.eos_id,
+        seed=args.seed)
+    if args.output:
+        np.save(args.output, np.asarray(out["tokens"], np.int32))
+        print(f"{args.output}: {np.asarray(out['tokens']).shape} tokens, "
+              f"lengths {out['lengths']}")
+    else:
+        _print(out)
+    return 0
+
+
 # --- dataset (reference cmd/dataset.go:49-86) ---
 
 
@@ -332,6 +349,22 @@ def build_parser() -> argparse.ArgumentParser:
     i.add_argument("--network", "-n", required=True, help="job id of the model")
     i.add_argument("--datafile", required=True, help=".npy file with inputs")
     i.set_defaults(fn=cmd_infer)
+
+    g = sub.add_parser("generate",
+                       help="sample continuations from a trained causal LM")
+    g.add_argument("--network", "-n", required=True, help="job id of the model")
+    g.add_argument("--datafile", required=True,
+                   help=".npy int array [batch, prompt_len] of token ids")
+    g.add_argument("--max-new-tokens", type=int, default=32)
+    g.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy; > 0 samples (seeded by --seed)")
+    g.add_argument("--top-k", type=int, default=None)
+    g.add_argument("--eos-id", type=int, default=None)
+    g.add_argument("--seed", type=int, default=None,
+                   help="sampling seed (required when --temperature > 0)")
+    g.add_argument("--output", "-o", default=None,
+                   help="write tokens to this .npy instead of stdout")
+    g.set_defaults(fn=cmd_generate)
 
     d = sub.add_parser("dataset", help="manage datasets")
     dsub = d.add_subparsers(dest="action", required=True)

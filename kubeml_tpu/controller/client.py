@@ -19,7 +19,8 @@ import numpy as np
 import requests
 
 from ..api.errors import error_from_envelope
-from ..api.types import DatasetSummary, History, InferRequest, TrainRequest, TrainTask
+from ..api.types import (DatasetSummary, GenerateRequest, History,
+                         InferRequest, TrainRequest, TrainTask)
 
 
 def _check(resp: requests.Response):
@@ -53,6 +54,19 @@ class _Networks:
         return _check(
             requests.post(f"{self.c.url}/infer", json=body.to_dict(), timeout=self.c.timeout)
         )["predictions"]
+
+    def generate(self, model_id: str, prompts: Any, *, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k=None, eos_id=None,
+                 seed=None) -> dict:
+        """Causal-LM sampling against a trained/live job; returns
+        {"tokens": [[...]], "lengths": [...]} (models.generation)."""
+        body = GenerateRequest(
+            model_id=model_id, prompts=np.asarray(prompts).tolist(),
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, eos_id=eos_id, seed=seed)
+        return _check(
+            requests.post(f"{self.c.url}/generate", json=body.to_dict(),
+                          timeout=max(self.c.timeout, 120)))
 
 
 class _Datasets:

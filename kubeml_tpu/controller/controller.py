@@ -18,7 +18,7 @@ from typing import Optional
 
 from ..api.config import Config, get_config
 from ..api.errors import KubeMLError
-from ..api.types import InferRequest, TrainRequest
+from ..api.types import GenerateRequest, InferRequest, TrainRequest
 from ..functions.registry import FunctionRegistry
 from ..storage.checkpoint import CheckpointStore
 from ..storage.history import HistoryStore
@@ -48,6 +48,7 @@ class Controller:
         router = Router("controller")
         router.route("POST", "/train", self._train)
         router.route("POST", "/infer", self._infer)
+        router.route("POST", "/generate", self._generate)
         router.route("GET", "/dataset", self._dataset_list)
         router.route("GET", "/dataset/{name}", self._dataset_get)
         router.route("POST", "/dataset/{name}", self._dataset_create)
@@ -84,6 +85,10 @@ class Controller:
     def _infer(self, req: Request):
         body = InferRequest.from_dict(req.json() or {})
         return {"predictions": self.scheduler.infer(body.model_id, body.data)}
+
+    def _generate(self, req: Request):
+        body = GenerateRequest.from_dict(req.json() or {})
+        return self.scheduler.generate(body)
 
     # --- datasets (reference storageApi.go) ---
 

@@ -720,6 +720,29 @@ class TrainJob:
         the job' gap — SURVEY §5 checkpoint/resume)."""
         return self._final_variables
 
+    def generate(self, req) -> dict:
+        """Serve a GenerateRequest from the live model (KV-cache decode,
+        models.generation). Variables resolve like infer: worker-0 slab on a
+        single host, the newest checkpoint snapshot multi-host."""
+        import jax
+
+        from ..models.generation import generate_from_request
+
+        if self._stacked_vars is None and self._final_variables is None:
+            raise KubeMLError(f"job {self.job_id} has no model yet", 400)
+        if self._final_variables is not None:
+            variables = self._final_variables
+        elif self.dist is not None and self.dist.size > 1:
+            snap = self._latest_snapshot or self._restore_serving_snapshot()
+            if snap is None:
+                raise KubeMLError(
+                    f"job {self.job_id} is training multi-host and has no "
+                    f"checkpoint yet; generation needs one", 409)
+            variables = snap[0]
+        else:
+            variables = jax.tree.map(lambda v: v[0], self._stacked_vars)
+        return generate_from_request(self.model.module, variables, req)
+
     def infer(self, x: np.ndarray):
         if self._stacked_vars is None:
             raise KubeMLError(f"job {self.job_id} has no model yet", 400)

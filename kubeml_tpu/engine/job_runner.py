@@ -85,6 +85,7 @@ class JobRunner:
         router.route("POST", "/update", self._update)
         router.route("DELETE", "/stop", self._stop)
         router.route("POST", "/infer", self._infer)
+        router.route("POST", "/generate", self._generate)
         router.route("GET", "/state", self._state)
         self.service = Service(router, self.cfg.host, port)
 
@@ -210,6 +211,17 @@ class JobRunner:
             raise KubeMLError(f"job {self.job_id} not started", 503)
         body = req.json() or {}
         return {"predictions": np.asarray(self.job.infer(np.asarray(body["data"]))).tolist()}
+
+    def _generate(self, req):
+        from ..api.errors import KubeMLError
+        from ..api.types import GenerateRequest
+
+        if self.job is None:
+            raise KubeMLError(f"job {self.job_id} not started", 503)
+        if not hasattr(self.job, "generate"):
+            raise KubeMLError(
+                f"job {self.job_id}'s engine does not serve generation", 400)
+        return self.job.generate(GenerateRequest.from_dict(req.json() or {}))
 
     def _state(self, req):
         epochs = len(self.job.history.train_loss) if self.job is not None else 0

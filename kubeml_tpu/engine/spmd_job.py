@@ -494,3 +494,21 @@ class SPMDJob:
             tokens = self.model.preprocess(jnp.asarray(np.asarray(x), jnp.int32))
             logits = self.model.module.apply(self.trainer.params, tokens, train=False)
             return np.asarray(jnp.argmax(logits, axis=-1))
+
+    def generate(self, req) -> dict:
+        """Serve a GenerateRequest from the live model (KV-cache decode,
+        models.generation). Single-host only, same as infer."""
+        if self.trainer.params is None:
+            raise KubeMLError(f"job {self.job_id} has no model yet", 400)
+        if self.dist is not None and self.dist.size > 1:
+            raise KubeMLError(
+                f"job {self.job_id} is training multi-host; generation is "
+                f"served from its checkpoint after it finishes", 409
+            )
+        import jax
+
+        from ..models.generation import generate_from_request
+
+        with self._step_lock, jax.set_mesh(self.mesh):
+            return generate_from_request(self.model.module,
+                                         self.trainer.params, req)

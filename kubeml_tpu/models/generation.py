@@ -38,6 +38,8 @@ Usage::
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
 from typing import NamedTuple, Optional
 
 import jax
@@ -144,8 +146,12 @@ def make_generate_fn(module, *, max_new_tokens: int, temperature: float = 0.0,
 # hashable (flax modules are frozen dataclasses, so equal configs share one
 # program even across fresh instances); falls back to id() for modules with
 # unhashable fields, holding the module ref so the id can't be recycled.
-_GENERATE_CACHE: "dict" = {}
+# Lock-guarded: the PS serves /generate from a threaded HTTP server, and a
+# hit must never mutate the dict in a way that makes a concurrent identical
+# request miss (a miss costs a ~20-27s jit compile on chip).
+_GENERATE_CACHE: OrderedDict = OrderedDict()
 _GENERATE_CACHE_MAX = 16
+_GENERATE_CACHE_LOCK = threading.Lock()
 
 
 def _cache_key(module, knobs):
@@ -169,7 +175,8 @@ def generate(module, variables, prompt_ids, *, max_new_tokens: int,
     on; ``lengths`` counts actually-generated tokens (a live row may emit
     vocab id 0 — e.g. "!" in GPT-2 — so trust ``lengths``, not a PAD scan).
     Prompts must be dense: decode mode treats every input token as real.
-    ``prompt_len + max_new_tokens`` must fit the model's ``max_len``.
+    ``prompt_len + max_new_tokens - 1`` must fit the model's ``max_len``
+    (the last sampled token is returned without a cache write).
     Compiles once per (knobs, shapes): repeat calls hit the cached program
     (chip-measured: the first GPT-2-small call compiles ~20s, repeats run at
     device rate — 3,513 tokens/sec for the 124M class through the dev
@@ -184,14 +191,54 @@ def generate(module, variables, prompt_ids, *, max_new_tokens: int,
         rng = jax.random.PRNGKey(0)
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     key = _cache_key(module, (max_new_tokens, float(temperature), top_k, eos_id))
-    entry = _GENERATE_CACHE.pop(key, None)  # pop+reinsert = LRU recency bump
+    with _GENERATE_CACHE_LOCK:
+        entry = _GENERATE_CACHE.get(key)  # hit: non-destructive recency bump
+        if entry is not None:
+            _GENERATE_CACHE.move_to_end(key)
     if entry is None:
-        if len(_GENERATE_CACHE) >= _GENERATE_CACHE_MAX:
-            _GENERATE_CACHE.pop(next(iter(_GENERATE_CACHE)))  # least recent
-        # the value holds the module ref too: for the id()-keyed fallback the
-        # id must not be recycled while the entry lives
-        entry = (module, make_generate_fn(
-            module, max_new_tokens=max_new_tokens, temperature=temperature,
-            top_k=top_k, eos_id=eos_id))
-    _GENERATE_CACHE[key] = entry
+        # build outside the lock (the jit wrapper is cheap; compilation is
+        # lazy at call time); setdefault keeps one winner under a race
+        fn = make_generate_fn(module, max_new_tokens=max_new_tokens,
+                              temperature=temperature, top_k=top_k,
+                              eos_id=eos_id)
+        with _GENERATE_CACHE_LOCK:
+            # the value holds the module ref too: for the id()-keyed fallback
+            # the id must not be recycled while the entry lives
+            entry = _GENERATE_CACHE.setdefault(key, (module, fn))
+            _GENERATE_CACHE.move_to_end(key)
+            while len(_GENERATE_CACHE) > _GENERATE_CACHE_MAX:
+                _GENERATE_CACHE.popitem(last=False)  # least recent
     return entry[1](variables, prompt_ids, rng)
+
+
+def generate_from_request(module, variables, req) -> dict:
+    """Serve an ``api.types.GenerateRequest`` — the wire-level entry shared by
+    the PS ``/generate`` route and the live job engines. Returns
+    ``{"tokens": [[...]], "lengths": [...]}``; user-shape problems (a module
+    with no decode path, bad prompt shapes, capacity overflow) surface as
+    KubeMLError 400, never a 500."""
+    import numpy as np
+
+    from ..api.errors import KubeMLError
+
+    prompts = np.asarray(req.prompts)
+    if prompts.ndim != 2 or not np.issubdtype(prompts.dtype, np.integer):
+        raise KubeMLError(
+            "prompts must be a [batch, prompt_len] integer token array", 400)
+    try:
+        rng = (jax.random.PRNGKey(req.seed) if req.seed is not None
+               else None)  # greedy path; sampling enforces a seed upstream
+        out = generate(module, variables, prompts.astype(np.int32),
+                       max_new_tokens=req.max_new_tokens,
+                       temperature=req.temperature, top_k=req.top_k,
+                       eos_id=req.eos_id, rng=rng)
+    except TypeError as e:
+        # flax raises TypeError for unexpected call kwargs — a module without
+        # decode support is a caller error, not a server fault
+        raise KubeMLError(
+            f"model does not support KV-cache decode (generation needs a "
+            f"causal LM like CausalTransformer): {e}", 400)
+    except ValueError as e:
+        raise KubeMLError(str(e), 400)
+    return {"tokens": np.asarray(out.tokens).tolist(),
+            "lengths": np.asarray(out.lengths).tolist()}

@@ -614,6 +614,46 @@ class ParameterServer:
         finally:
             self.metrics.task_finished("inference")
 
+    def generate(self, model_id: str, req) -> dict:
+        """`/generate`: autoregressive sampling from a causal-LM job (live
+        in-process, live standalone via its runner, or finished via the final
+        checkpoint). Extension — the reference serves forward passes only."""
+        from ..api.types import GenerateRequest
+
+        if not isinstance(req, GenerateRequest):
+            req = GenerateRequest(**{**req, "model_id": model_id})
+        with self._lock:
+            record = self._jobs.get(model_id)
+        if record is not None and record.url is not None:
+            import requests
+
+            from ..api.errors import error_from_envelope
+
+            r = requests.post(f"{record.url}/generate", json=req.to_dict(),
+                              timeout=120)
+            if r.status_code >= 400:
+                raise error_from_envelope(r.content, r.status_code)
+            return r.json()
+        if record is not None:
+            if record.job is None:
+                raise KubeMLError(f"job {model_id} is still starting", 503)
+            if not hasattr(record.job, "generate"):
+                raise KubeMLError(
+                    f"job {model_id}'s engine does not serve generation", 400)
+            self.metrics.task_started("inference")
+            try:
+                return record.job.generate(req)
+            finally:
+                self.metrics.task_finished("inference")
+        from ..models.generation import generate_from_request
+
+        model, variables = self._load_serving(model_id)
+        self.metrics.task_started("inference")
+        try:
+            return generate_from_request(model.module, variables, req)
+        finally:
+            self.metrics.task_finished("inference")
+
     def _infer_from_socket(self, model_id: str, record, data) -> Optional[list]:
         """Serve a live standalone job from its runner's tensor socket; None
         when unavailable (socket off/absent, or no epoch published yet) —
@@ -654,9 +694,10 @@ class ParameterServer:
         finally:
             self.metrics.task_finished("inference")
 
-    def _infer_from_checkpoint(self, model_id: str, data) -> list:
-        import jax.numpy as jnp
-
+    def _load_serving(self, model_id: str):
+        """(model, variables) for a FINISHED job from its exported final
+        checkpoint, via the mtime-validated serving cache. Shared by /infer
+        and /generate."""
         from ..api.errors import CheckpointNotFoundError, StorageError
 
         store = self._ckpt_store
@@ -689,7 +730,12 @@ class ParameterServer:
                 self._serving_cache[model_id] = cached
                 while len(self._serving_cache) > SERVING_CACHE_SIZE:
                     self._serving_cache.pop(next(iter(self._serving_cache)))
-        model, variables = cached[0], cached[1]
+        return cached[0], cached[1]
+
+    def _infer_from_checkpoint(self, model_id: str, data) -> list:
+        import jax.numpy as jnp
+
+        model, variables = self._load_serving(model_id)
         self.metrics.task_started("inference")
         try:
             # same device-side input pipeline as training/live serving: a model

@@ -105,6 +105,11 @@ class Scheduler:
         """`/infer`: bypasses the queue straight to the serving path (api.go:119-162)."""
         return self.ps.infer(model_id, data)
 
+    def generate(self, req):
+        """`/generate`: causal-LM sampling, queue-bypassing like /infer
+        (extension — no reference counterpart, which is classifier-only)."""
+        return self.ps.generate(req.model_id, req)
+
     # --- loop ---
 
     def start(self) -> "Scheduler":

@@ -15,7 +15,7 @@ import requests
 
 from ..api.config import Config, get_config
 from ..api.errors import error_from_envelope
-from ..api.types import InferRequest, TrainRequest, TrainTask
+from ..api.types import GenerateRequest, InferRequest, TrainRequest, TrainTask
 from ..utils.httpd import Request, Router, Service
 from .scheduler import Scheduler
 
@@ -27,6 +27,7 @@ class SchedulerAPI:
         router = Router("scheduler")
         router.route("POST", "/train", self._train)
         router.route("POST", "/infer", self._infer)
+        router.route("POST", "/generate", self._generate)
         router.route("POST", "/job", self._job)
         router.route("DELETE", "/finish/{taskId}", self._finish)
         self.service = Service(router, self.cfg.host, self.cfg.scheduler_port)
@@ -38,6 +39,10 @@ class SchedulerAPI:
     def _infer(self, req: Request):
         body = InferRequest.from_dict(req.json() or {})
         return {"predictions": self.scheduler.infer(body.model_id, body.data)}
+
+    def _generate(self, req: Request):
+        body = GenerateRequest.from_dict(req.json() or {})
+        return self.scheduler.generate(body)
 
     def _job(self, req: Request):
         self.scheduler.update_job(TrainTask.from_dict(req.json() or {}))
@@ -86,6 +91,12 @@ class SchedulerClient:
             )
         )
         return r["predictions"]
+
+    def generate(self, req: "GenerateRequest") -> dict:
+        return _check(
+            requests.post(f"{self.url}/generate", json=req.to_dict(),
+                          timeout=max(self.timeout, 120))
+        )
 
     def update_job(self, task: TrainTask) -> None:
         _check(requests.post(f"{self.url}/job", json=task.to_dict(), timeout=self.timeout))

@@ -254,6 +254,12 @@ class TestClusterEndToEnd:
                 TrainRequest(batch_size=16, epochs=1, dataset="blobs", function_name="nope")
             )
 
+        # /generate over the full HTTP chain: a non-causal model is a clean
+        # 400 (the KV-cache decode contract), never a 500
+        with pytest.raises(KubeMLError) as ei:
+            client.networks().generate(job_id, [[1, 2, 3]], max_new_tokens=2)
+        assert ei.value.status_code < 500
+
         # history CRUD
         assert client.histories().prune() >= 1
         client.datasets().delete("blobs")

@@ -109,6 +109,62 @@ def test_spmd_job_through_ps(token_store, tmp_config):
     assert np.isfinite(hist.train_loss[0])
 
 
+def test_generate_served_live_and_from_checkpoint(token_store, tmp_config):
+    """/generate serves a causal-LM job at every lifecycle stage: live
+    (SPMDJob.generate under the PS), and finished (PS serving-cache path from
+    the final checkpoint). Greedy decode; max_len=16 caps prompt+new-1."""
+    from kubeml_tpu.api.types import GenerateRequest, TrainTask
+    from kubeml_tpu.functions.registry import FunctionRegistry
+    from kubeml_tpu.ps.parameter_server import ParameterServer
+
+    reg = FunctionRegistry(config=tmp_config)
+    reg.create("lmfn", LM_FN)
+    ps = ParameterServer(registry=reg, store=token_store, config=tmp_config)
+    req = _spmd_request(epochs=3)
+    ps.start_task(TrainTask(job_id="gen1", parameters=req))
+
+    prompts = token_data(2, l=6, seed=3)  # dense (no pad column)
+    greq = GenerateRequest(model_id="gen1", prompts=prompts.tolist(),
+                           max_new_tokens=5)
+    live = None
+    deadline = time.time() + 300
+    while time.time() < deadline and not ps.wait("gen1", timeout=0.5):
+        try:
+            live = ps.generate("gen1", greq)
+            break
+        except Exception:  # starting up (503) or first epoch not done yet
+            pass
+    assert ps.wait("gen1", timeout=300)
+
+    done = ps.generate("gen1", greq)  # finished -> checkpoint serving cache
+    for out in filter(None, (live, done)):
+        toks = np.asarray(out["tokens"])
+        assert toks.shape == (2, 5)
+        assert np.all((toks >= 0) & (toks < 64))
+        assert list(out["lengths"]) == [5, 5]
+
+    # greedy from the same final weights is deterministic
+    again = ps.generate("gen1", greq)
+    assert again["tokens"] == done["tokens"]
+
+    # capacity overflow surfaces as a 400-class error, not corruption
+    from kubeml_tpu.api.errors import KubeMLError
+
+    with pytest.raises(KubeMLError):
+        ps.generate("gen1", GenerateRequest(
+            model_id="gen1", prompts=prompts.tolist(), max_new_tokens=30))
+
+    # sampling without a seed is rejected at the wire type (a silent default
+    # would make every served "sample" identical)
+    with pytest.raises(ValueError, match="seed"):
+        GenerateRequest(model_id="gen1", prompts=prompts.tolist(),
+                        max_new_tokens=2, temperature=0.8)
+    out = ps.generate("gen1", GenerateRequest(
+        model_id="gen1", prompts=prompts.tolist(), max_new_tokens=2,
+        temperature=0.8, seed=7))
+    assert np.asarray(out["tokens"]).shape == (2, 2)
+
+
 def test_spmd_job_resume(token_store, tmp_config):
     """--resume restores the checkpointed params and continues the epoch count."""
     from kubeml_tpu.engine.spmd_job import SPMDJob
