@@ -75,6 +75,19 @@ class _JsonMixin:
         return obj
 
     @classmethod
+    def parse_request(cls, d: Dict[str, Any]):
+        """``from_dict`` for wire handlers: ``__post_init__`` validation
+        failures (batch bounds, sampling knobs, ...) surface as a 400-class
+        KubeMLError instead of an unlogged ValueError that the HTTP layer
+        would report as a 500 server fault."""
+        from .errors import KubeMLError
+
+        try:
+            return cls.from_dict(d)
+        except (ValueError, TypeError) as e:
+            raise KubeMLError(f"invalid {cls.__name__}: {e}", 400)
+
+    @classmethod
     def from_json(cls, s: str):
         return cls.from_dict(json.loads(s))
 

@@ -87,7 +87,7 @@ class Controller:
         return {"predictions": self.scheduler.infer(body.model_id, body.data)}
 
     def _generate(self, req: Request):
-        body = GenerateRequest.from_dict(req.json() or {})
+        body = GenerateRequest.parse_request(req.json() or {})
         return self.scheduler.generate(body)
 
     # --- datasets (reference storageApi.go) ---

@@ -221,7 +221,7 @@ class JobRunner:
         if not hasattr(self.job, "generate"):
             raise KubeMLError(
                 f"job {self.job_id}'s engine does not serve generation", 400)
-        return self.job.generate(GenerateRequest.from_dict(req.json() or {}))
+        return self.job.generate(GenerateRequest.parse_request(req.json() or {}))
 
     def _state(self, req):
         epochs = len(self.job.history.train_loss) if self.job is not None else 0

@@ -189,6 +189,9 @@ def generate(module, variables, prompt_ids, *, max_new_tokens: int,
                          "same draw")
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    if temperature <= 0.0:
+        top_k = None  # greedy ignores top_k — normalizing the key keeps
+        # byte-identical programs from compiling (and caching) twice
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     key = _cache_key(module, (max_new_tokens, float(temperature), top_k, eos_id))
     with _GENERATE_CACHE_LOCK:
@@ -225,6 +228,18 @@ def generate_from_request(module, variables, req) -> dict:
     if prompts.ndim != 2 or not np.issubdtype(prompts.dtype, np.integer):
         raise KubeMLError(
             "prompts must be a [batch, prompt_len] integer token array", 400)
+    # probe decode support EXPLICITLY (signature, not a TypeError net around
+    # the whole pipeline — that would relabel genuine server bugs as 400s)
+    import inspect
+
+    try:
+        supports_decode = "decode" in inspect.signature(module.__call__).parameters
+    except (TypeError, ValueError):
+        supports_decode = False
+    if not supports_decode:
+        raise KubeMLError(
+            "model does not support KV-cache decode (generation needs a "
+            "causal LM like CausalTransformer)", 400)
     try:
         rng = (jax.random.PRNGKey(req.seed) if req.seed is not None
                else None)  # greedy path; sampling enforces a seed upstream
@@ -232,13 +247,8 @@ def generate_from_request(module, variables, req) -> dict:
                        max_new_tokens=req.max_new_tokens,
                        temperature=req.temperature, top_k=req.top_k,
                        eos_id=req.eos_id, rng=rng)
-    except TypeError as e:
-        # flax raises TypeError for unexpected call kwargs — a module without
-        # decode support is a caller error, not a server fault
-        raise KubeMLError(
-            f"model does not support KV-cache decode (generation needs a "
-            f"causal LM like CausalTransformer): {e}", 400)
     except ValueError as e:
+        # the deliberate user-input guards (cache capacity, rng-for-sampling)
         raise KubeMLError(str(e), 400)
     return {"tokens": np.asarray(out.tokens).tolist(),
             "lengths": np.asarray(out.lengths).tolist()}

@@ -621,7 +621,7 @@ class ParameterServer:
         from ..api.types import GenerateRequest
 
         if not isinstance(req, GenerateRequest):
-            req = GenerateRequest(**{**req, "model_id": model_id})
+            req = GenerateRequest.parse_request({**req, "model_id": model_id})
         with self._lock:
             record = self._jobs.get(model_id)
         if record is not None and record.url is not None:

@@ -41,7 +41,7 @@ class SchedulerAPI:
         return {"predictions": self.scheduler.infer(body.model_id, body.data)}
 
     def _generate(self, req: Request):
-        body = GenerateRequest.from_dict(req.json() or {})
+        body = GenerateRequest.parse_request(req.json() or {})
         return self.scheduler.generate(body)
 
     def _job(self, req: Request):

@@ -128,11 +128,6 @@ class Service:
                     )
                 except KubeMLError as e:
                     resp = Response(e.to_dict(), status=e.status_code)
-                except ValueError as e:
-                    # dataclass __post_init__ validation (TrainRequest batch
-                    # bounds, GenerateRequest knobs, ...) — malformed client
-                    # input is a 400, not a logged server fault
-                    resp = Response({"error": str(e), "code": 400}, status=400)
                 except BrokenPipeError:
                     return
                 except Exception as e:  # generic 500 envelope (server.py:133-151)
