@@ -205,8 +205,19 @@ class GenerateRequest(_JsonMixin):
     seed: Optional[int] = None   # required when temperature > 0
 
     def __post_init__(self):
+        # knob TYPES are validated here too — a wrong-typed top_k would
+        # otherwise surface as a TypeError deep inside jit tracing, which the
+        # HTTP layer reports as a server fault instead of the 400 it is
+        for name in ("max_new_tokens", "top_k", "eos_id", "seed"):
+            v = getattr(self, name)
+            if v is not None and not isinstance(v, int):
+                raise ValueError(f"{name} must be an integer, got {type(v).__name__}")
+        if not isinstance(self.temperature, (int, float)):
+            raise ValueError("temperature must be a number")
         if self.max_new_tokens <= 0:
             raise ValueError("max_new_tokens must be positive")
+        if self.top_k is not None and self.top_k <= 0:
+            raise ValueError("top_k must be positive")
         if self.temperature < 0:
             raise ValueError("temperature must be >= 0")
         if self.temperature > 0 and self.seed is None:

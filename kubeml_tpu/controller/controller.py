@@ -73,7 +73,7 @@ class Controller:
     # --- train / infer (reference networkApi.go:12-72) ---
 
     def _train(self, req: Request):
-        train_req = TrainRequest.from_dict(req.json() or {})
+        train_req = TrainRequest.parse_request(req.json() or {})
         # reference CLI validates dataset+function existence before submitting
         # (cmd/train.go:87-119); the gateway enforces it for all clients
         if not self.store.exists(train_req.dataset):
@@ -83,7 +83,7 @@ class Controller:
         return {"id": self.scheduler.submit_train(train_req)}
 
     def _infer(self, req: Request):
-        body = InferRequest.from_dict(req.json() or {})
+        body = InferRequest.parse_request(req.json() or {})
         return {"predictions": self.scheduler.infer(body.model_id, body.data)}
 
     def _generate(self, req: Request):

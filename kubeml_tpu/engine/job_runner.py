@@ -130,7 +130,7 @@ class JobRunner:
         with self._lock:
             if self.job is not None:
                 raise KubeMLError(f"job {self.job_id} already started", 400)
-            task = TrainTask.from_dict(req.json() or {})
+            task = TrainTask.parse_request(req.json() or {})
             request = task.parameters
             model = FunctionRegistry(config=self.cfg).load(request.function_name)
             model._set_params(lr=request.lr, batch_size=request.batch_size,

@@ -37,7 +37,7 @@ class PSAPI:
         self.service = Service(router, self.cfg.host, self.cfg.ps_port)
 
     def _start(self, req: Request):
-        self.ps.start_task(TrainTask.from_dict(req.json() or {}))
+        self.ps.start_task(TrainTask.parse_request(req.json() or {}))
         return {}
 
     def _update(self, req: Request):
@@ -64,7 +64,7 @@ class PSAPI:
     def _metrics_update(self, req: Request):
         from ..api.types import MetricUpdate
 
-        update = MetricUpdate.from_dict(req.json() or {})
+        update = MetricUpdate.parse_request(req.json() or {})
         update.job_id = req.params["jobId"]
         self.ps.metrics.update(update)
         return {}

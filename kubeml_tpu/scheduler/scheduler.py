@@ -18,6 +18,7 @@ import uuid
 from typing import Optional
 
 from ..api.config import Config, get_config
+from ..api.errors import KubeMLError
 from ..api.types import JobState, TrainRequest, TrainTask
 from .policy import SchedulerPolicy, ThroughputBasedPolicy
 from .queue import TaskQueue
@@ -73,14 +74,15 @@ class Scheduler:
         reference always mints, util.go:8-10) — but rejected with 409 while a
         job with that id is still queued or running, so a duplicate submission
         fails at /train instead of silently dying in the scheduler loop."""
-        request.validate()
+        try:
+            request.validate()
+        except ValueError as e:  # client input -> 400, not an unlogged 500
+            raise KubeMLError(str(e), 400)
         with self._active_lock:
             if request.job_id and (
                 request.job_id in self._active_ids
                 or any(t.job_id == request.job_id for t in self.ps.list_tasks())
             ):
-                from ..api.errors import KubeMLError
-
                 raise KubeMLError(f"job {request.job_id!r} is still active", 409)
             job_id = request.job_id or create_job_id()
             self._active_ids.add(job_id)

@@ -33,11 +33,11 @@ class SchedulerAPI:
         self.service = Service(router, self.cfg.host, self.cfg.scheduler_port)
 
     def _train(self, req: Request):
-        train_req = TrainRequest.from_dict(req.json() or {})
+        train_req = TrainRequest.parse_request(req.json() or {})
         return {"id": self.scheduler.submit_train(train_req)}
 
     def _infer(self, req: Request):
-        body = InferRequest.from_dict(req.json() or {})
+        body = InferRequest.parse_request(req.json() or {})
         return {"predictions": self.scheduler.infer(body.model_id, body.data)}
 
     def _generate(self, req: Request):
@@ -45,7 +45,7 @@ class SchedulerAPI:
         return self.scheduler.generate(body)
 
     def _job(self, req: Request):
-        self.scheduler.update_job(TrainTask.from_dict(req.json() or {}))
+        self.scheduler.update_job(TrainTask.parse_request(req.json() or {}))
         return {}
 
     def _finish(self, req: Request):

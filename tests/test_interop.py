@@ -179,6 +179,22 @@ class TestGPT2Import:
         ours = np.asarray(model.apply(variables, jnp.asarray(ids), train=False))
         np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
 
+    def test_greedy_generation_matches_hf(self, pair_gpt2):
+        """The KV-cache decode path on an IMPORTED checkpoint reproduces HF's
+        own greedy continuation token-for-token (argmax over logits already
+        proven equal to 2e-4; random weights make ties astronomically
+        unlikely)."""
+        from kubeml_tpu.models.generation import generate
+
+        hf, model, variables = pair_gpt2
+        ids = np.random.default_rng(1).integers(1, 97, size=(2, 10))
+        with torch.no_grad():
+            ref = hf.generate(torch.from_numpy(ids), max_new_tokens=8,
+                              do_sample=False, pad_token_id=0).numpy()[:, 10:]
+        ours = np.asarray(generate(model, variables, ids,
+                                   max_new_tokens=8).tokens)
+        np.testing.assert_array_equal(ours, ref)
+
     def test_tree_matches_init_shapes(self, pair_gpt2):
         import jax
 

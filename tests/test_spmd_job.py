@@ -153,8 +153,6 @@ def test_generate_served_live_and_from_checkpoint(token_store, tmp_config):
     assert again["tokens"] == done["tokens"]
 
     # capacity overflow surfaces as a 400-class error, not corruption
-    from kubeml_tpu.api.errors import KubeMLError
-
     with pytest.raises(KubeMLError):
         ps.generate("gen1", GenerateRequest(
             model_id="gen1", prompts=prompts.tolist(), max_new_tokens=30))
