@@ -43,4 +43,12 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    rc = main()
+    # same teardown-segfault guard as multihost_proc.py: jax.distributed's
+    # Gloo client can SIGSEGV in C++ destructors at exit; results are
+    # already flushed by now
+    sys.stdout.flush()
+    sys.stderr.flush()
+    import os
+
+    os._exit(rc)

@@ -353,4 +353,13 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    rc = main()
+    # Skip interpreter teardown: jax.distributed's Gloo-backed client can
+    # segfault in its C++ destructors during exit (observed as returncode -11
+    # AFTER "RESULT n OK" under CPU contention), and the result JSON is
+    # already written and flushed — teardown has nothing left to protect.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    import os
+
+    os._exit(rc)
