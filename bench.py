@@ -220,7 +220,9 @@ def main():
     flops = costs["flops"]
     rounds_per_sec = device_sps / samples_per_round
     mfu = mfu_from(flops, rounds_per_sec)
-    ceiling = roofline_mfu(flops, costs["bytes_accessed"])
+    # post-fusion HBM traffic (bytes_hbm) — the pre-fusion per-op count made
+    # fused conv models "exceed" their own ceiling (VERDICT r3 weak #2)
+    ceiling = roofline_mfu(flops, costs["bytes_hbm"])
 
     # MEASURED comparator denominator (the reference's own methodology —
     # ml/experiments/common/experiment.py:263-337): a same-architecture torch

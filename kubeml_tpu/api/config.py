@@ -74,6 +74,18 @@ class Config:
         default_factory=lambda: _env_bool("KUBEML_TENSOR_SOCKETS", True)
     )
 
+    # --- /generate serving (kubeml_tpu.serving.BatchingDecoder) ---
+    # continuous batching coalesces concurrent decode requests into one
+    # slot-based batched loop (decode is HBM-bound: batch is ~free throughput)
+    serving_batcher: bool = field(
+        default_factory=lambda: _env_bool("KUBEML_SERVING_BATCHER", True)
+    )
+    # resident decode slots (KV-cache HBM scales linearly with this)
+    serving_slots: int = field(default_factory=lambda: _env_int("KUBEML_SERVING_SLOTS", 8))
+    # decode steps per host round-trip: larger amortizes dispatch, smaller
+    # tightens admission latency for newly arriving requests
+    serving_chunk_steps: int = field(default_factory=lambda: _env_int("KUBEML_SERVING_CHUNK", 8))
+
     def job_socket_path(self, job_id: str):
         """Unix-socket path for a standalone job's tensor server. Lives under
         the system tmpdir (unix socket paths cap at ~107 bytes — a deep

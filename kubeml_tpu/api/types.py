@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import re
 import time
 from dataclasses import dataclass, field
@@ -190,40 +191,111 @@ class InferRequest(_JsonMixin):
     data: Any = None
 
 
+# Serving-side caps on /generate requests. Every distinct knob/shape
+# combination costs an XLA compile (~20-27s on chip), so unbounded client
+# knobs are a compile-DoS vector; these bound the worst case and are
+# overridable per deployment via the environment.
+GENERATE_MAX_NEW_TOKENS_CAP = int(os.environ.get("KUBEML_GENERATE_MAX_NEW_TOKENS", "2048"))
+GENERATE_MAX_BATCH = int(os.environ.get("KUBEML_GENERATE_MAX_BATCH", "64"))
+GENERATE_MAX_PROMPT_LEN = int(os.environ.get("KUBEML_GENERATE_MAX_PROMPT_LEN", "8192"))
+# mirrors the continuous batcher's static top-k scratch width (serving.batcher.TOP_K_MAX)
+GENERATE_MAX_TOP_K = int(os.environ.get("KUBEML_GENERATE_MAX_TOP_K", "128"))
+
+
 @dataclass
 class GenerateRequest(_JsonMixin):
     """Autoregressive sampling against a trained causal-LM job (extension —
     the reference serves classifier forward passes only; this is the KV-cache
-    decode path, kubeml_tpu.models.generation)."""
+    decode path, kubeml_tpu.models.generation).
+
+    ``prompts`` rows are DENSE token ids: decode treats every token as real,
+    so a ragged batch padded with 0s would silently attend to the pads.
+    Ragged batches are served correctly by passing ``prompt_lengths`` (one
+    true length per row; tokens past it are ignored) — the continuous
+    batcher decodes each row at its own length."""
 
     model_id: str = ""
-    prompts: Any = None          # [B, Lp] int token ids (dense, no pad rows)
+    prompts: Any = None          # [B, Lp] int token ids (dense unless prompt_lengths)
     max_new_tokens: int = 32
     temperature: float = 0.0     # 0 = greedy; > 0 requires an explicit seed
     top_k: Optional[int] = None
     eos_id: Optional[int] = None
     seed: Optional[int] = None   # required when temperature > 0
+    # true per-row prompt lengths for ragged batches (see class docstring)
+    prompt_lengths: Optional[Any] = None
+    # stream=True: the server answers with chunked JSON-lines, one line per
+    # emitted token group, instead of a single JSON body at the end
+    stream: bool = False
 
     def __post_init__(self):
         # knob TYPES are validated here too — a wrong-typed top_k would
         # otherwise surface as a TypeError deep inside jit tracing, which the
-        # HTTP layer reports as a server fault instead of the 400 it is
+        # HTTP layer reports as a server fault instead of the 400 it is.
+        # bool is excluded explicitly: JSON `true` must not coerce to 1.
         for name in ("max_new_tokens", "top_k", "eos_id", "seed"):
             v = getattr(self, name)
-            if v is not None and not isinstance(v, int):
+            if v is not None and (isinstance(v, bool) or not isinstance(v, int)):
                 raise ValueError(f"{name} must be an integer, got {type(v).__name__}")
-        if not isinstance(self.temperature, (int, float)):
+        if isinstance(self.temperature, bool) or not isinstance(self.temperature, (int, float)):
             raise ValueError("temperature must be a number")
+        if not isinstance(self.stream, bool):
+            raise ValueError("stream must be a boolean")
         if self.max_new_tokens <= 0:
             raise ValueError("max_new_tokens must be positive")
+        if self.max_new_tokens > GENERATE_MAX_NEW_TOKENS_CAP:
+            raise ValueError(
+                f"max_new_tokens exceeds the serving cap "
+                f"({GENERATE_MAX_NEW_TOKENS_CAP}; KUBEML_GENERATE_MAX_NEW_TOKENS)")
         if self.top_k is not None and self.top_k <= 0:
             raise ValueError("top_k must be positive")
+        if self.top_k is not None and self.top_k > GENERATE_MAX_TOP_K:
+            raise ValueError(
+                f"top_k exceeds the serving cap "
+                f"({GENERATE_MAX_TOP_K}; KUBEML_GENERATE_MAX_TOP_K)")
         if self.temperature < 0:
             raise ValueError("temperature must be >= 0")
         if self.temperature > 0 and self.seed is None:
             # mirrors models.generation.generate's rng guard: a silent default
             # seed would return the identical "sample" on every request
             raise ValueError("temperature > 0 requires an explicit seed")
+        if self.prompts is not None:
+            try:
+                batch = len(self.prompts)
+                longest = max((len(r) for r in self.prompts), default=0)
+            except TypeError:
+                raise ValueError("prompts must be a [batch, prompt_len] token array")
+            if batch > GENERATE_MAX_BATCH:
+                raise ValueError(
+                    f"prompt batch exceeds the serving cap "
+                    f"({GENERATE_MAX_BATCH}; KUBEML_GENERATE_MAX_BATCH)")
+            if longest > GENERATE_MAX_PROMPT_LEN:
+                raise ValueError(
+                    f"prompt length exceeds the serving cap "
+                    f"({GENERATE_MAX_PROMPT_LEN}; KUBEML_GENERATE_MAX_PROMPT_LEN)")
+            if self.prompt_lengths is not None:
+                pl = self.prompt_lengths
+                if (not isinstance(pl, (list, tuple)) or len(pl) != batch
+                        or any(isinstance(v, bool) or not isinstance(v, int)
+                               for v in pl)):
+                    raise ValueError(
+                        "prompt_lengths must be one integer per prompt row")
+                if any(v < 1 or v > longest for v in pl):
+                    raise ValueError(
+                        "prompt_lengths entries must be in [1, prompt width]")
+
+
+def generate_timeout(req: "GenerateRequest", floor: float = 120.0) -> float:
+    """HTTP timeout for forwarding a /generate hop. The first call on a new
+    knob/shape combination pays a ~20-27s XLA compile before any decode work,
+    and decode time itself grows with tokens x batch — so the budget scales
+    with the request instead of a flat constant that big-but-healthy requests
+    would blow through."""
+    batch = 1
+    try:
+        batch = max(1, len(req.prompts))
+    except TypeError:
+        pass
+    return max(floor, 60.0 + 0.05 * req.max_new_tokens * batch)
 
 
 @dataclass

@@ -19,6 +19,7 @@ override with ``KUBEML_PEAK_FLOPS`` (in TFLOP/s) for unlisted hardware.
 from __future__ import annotations
 
 import os
+import re
 from typing import Optional
 
 import jax
@@ -74,48 +75,168 @@ def hbm_bandwidth(device: Optional[jax.Device] = None) -> Optional[float]:
     return _device_spec(_BWS, "KUBEML_HBM_BW", 1e9, device)
 
 
-def roofline_mfu(flops: Optional[float], bytes_accessed: Optional[float],
+def roofline_mfu(flops: Optional[float], hbm_bytes: Optional[float],
                  device: Optional[jax.Device] = None) -> Optional[float]:
     """The MFU CEILING the classic roofline model allows this program:
 
-        intensity = flops / bytes_accessed          (FLOPs per HBM byte)
+        intensity = flops / hbm_bytes               (FLOPs per HBM byte)
         ceiling   = min(peak, intensity * HBM_BW) / peak
 
     A measured MFU near this ceiling means the program is BANDWIDTH-bound and
     no kernel tuning will push utilization past it — the lever is arithmetic
     intensity (bigger batch, fusion, lower-precision activations). Far below
     the ceiling means compute-side headroom (gaps, small matmuls, dispatch).
-    bytes_accessed comes from the same XLA cost analysis as the FLOPs, so
-    this is the compiler's own accounting, not an analytic guess.
 
-    Caveat (measured, round 3): XLA counts bytes per op BEFORE fusion, so
-    the ceiling is CONSERVATIVE — for heavily-fused conv models the
-    overcount is big enough that measured MFU can exceed it (ViT-Tiny:
-    24.6% measured vs a 12.1% "ceiling"). Trust the ceiling only when it
-    sits well above the measured value; see BASELINE.md."""
+    ``hbm_bytes`` must be POST-fusion traffic (``post_fusion_bytes`` /
+    ``compiled_costs()['bytes_hbm']``). Round 3 fed this XLA's per-op
+    ``bytes accessed``, which is counted BEFORE fusion — the resulting
+    "ceiling" sat BELOW measured MFU on fused conv models (ResNet-18: 27.4%
+    ceiling vs 40.2% measured; a bound that measurement exceeds bounds
+    nothing). The post-fusion count walks the optimized HLO: each surviving
+    top-level op reads its operands and writes its outputs once."""
     peak = peak_flops(device)
     bw = hbm_bandwidth(device)
-    if not flops or not bytes_accessed or not peak or not bw:
+    if not flops or not hbm_bytes or not peak or not bw:
         return None
-    return min(peak, (flops / bytes_accessed) * bw) / peak
+    return min(peak, (flops / hbm_bytes) * bw) / peak
+
+
+# byte widths of HLO primitive element types (for post_fusion_bytes)
+_ELEM_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e5m2": 1, "f8e4m3fn": 1, "f8e4m3": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16, "s4": 1, "u4": 1, "token": 0, "opaque": 0,
+}
+
+# top-level ops that move no HBM bytes of their own: pure aliasing/plumbing
+# (their consumers' operand counts cover any real reads)
+_FREE_OPS = {
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "after-all", "partition-id", "replica-id", "iota", "add-dependency",
+    "bitcast-convert", "opt-barrier", "domain",
+}
+
+# control-flow ops whose CALLED computations execute at top level (their
+# bodies' traffic is real); fusion/reduce bodies stay un-traversed — that is
+# exactly the post-fusion point
+_CALLER_ATTRS = ("body=", "condition=", "true_computation=",
+                 "false_computation=", "branch_computations=")
+
+_SHAPE_RX = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_INSTR_RX = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(\(.*\)|\S+)\s+([a-z][a-z0-9\-]*)\((.*)$")
+# computation headers sit at column 0 and end with '{' (instructions are
+# indented); the name may carry an ENTRY marker. Param annotations can
+# contain '=' (/*index=5*/ comments), so no '=' heuristics here.
+_COMP_RX = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s*\(")
+
+
+def _shape_bytes(shape_text: str) -> int:
+    """Total bytes of an HLO shape string — 'f32[128,64]{1,0:T(8,128)}' or a
+    tuple '(f32[2]{0}, s32[])'. Layout/tiling annotations are ignored."""
+    total = 0
+    for elem, dims in _SHAPE_RX.findall(shape_text):
+        width = _ELEM_BYTES.get(elem)
+        if width is None:
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * width
+    return total
+
+
+def post_fusion_bytes(hlo_text: str) -> Optional[float]:
+    """Idealized HBM traffic of an OPTIMIZED (post-fusion) HLO module: every
+    surviving top-level instruction reads each operand once and writes its
+    outputs once; fusion bodies are not traversed (their intermediates live
+    in registers/VMEM — that is what fusion means); while/conditional bodies
+    are (they execute at top level; trip counts are not multiplied, matching
+    XLA cost_analysis' scan-body-once convention that ``round_costs``
+    compensates for by lowering 1-step programs).
+
+    This replaces XLA's pre-fusion per-op ``bytes accessed`` in the roofline
+    ceiling — the pre-fusion count made fused conv models "exceed" their own
+    ceiling (VERDICT r3 weak #2)."""
+    comps: dict = {}
+    current = None
+    entry = None
+    for line in hlo_text.splitlines():
+        if line and not line[0].isspace() and line.rstrip().endswith("{"):
+            m = _COMP_RX.match(line)
+            if m:
+                current = {"instrs": [], "defs": {}}
+                comps[m.group(2)] = current
+                if m.group(1):
+                    entry = current
+                continue
+        if line.strip() == "}":
+            current = None
+            continue
+        if current is None:
+            continue
+        im = _INSTR_RX.match(line)
+        if not im:
+            continue
+        name, shape_text, opcode, rest = im.groups()
+        out_bytes = _shape_bytes(shape_text)
+        current["defs"][name] = out_bytes
+        current["instrs"].append((name, opcode, out_bytes, rest))
+    if entry is None:
+        return None
+
+    def comp_traffic(comp, seen) -> float:
+        total = 0.0
+        for name, opcode, out_bytes, rest in comp["instrs"]:
+            called = []
+            if any(a in rest for a in _CALLER_ATTRS) or opcode == "call":
+                for ref in re.findall(r"%?([\w.\-]+)", rest):
+                    sub = comps.get(ref)
+                    if sub is not None and id(sub) not in seen:
+                        called.append(sub)
+            for sub in called:
+                total += comp_traffic(sub, seen | {id(sub)})
+            if opcode in _FREE_OPS:
+                continue
+            operands = 0.0
+            # operand list: the leading %refs before any attribute clause;
+            # resolve against this computation's defs (ignores attr refs)
+            for ref in re.findall(r"%([\w.\-]+)", rest):
+                if ref in comp["defs"]:
+                    operands += comp["defs"][ref]
+            total += out_bytes + operands
+        return total
+
+    traffic = comp_traffic(entry, {id(entry)})
+    return traffic if traffic > 0 else None
 
 
 def compiled_costs(jitted_fn, *args, **kwargs) -> dict:
-    """{'flops': ..., 'bytes_accessed': ...} of one invocation from the
-    compiled executable's cost analysis (either may be absent -> None).
-    Same lax.scan caveat as ``compiled_flops``."""
-    out = {"flops": None, "bytes_accessed": None}
+    """{'flops', 'bytes_accessed', 'bytes_hbm'} of one invocation (any may be
+    absent -> None). ``flops`` / ``bytes_accessed`` come from the compiled
+    executable's cost analysis (pre-fusion per-op accounting); ``bytes_hbm``
+    is the post-fusion traffic parse of the optimized HLO — feed THAT to
+    ``roofline_mfu``. Same lax.scan caveat as ``compiled_flops``."""
+    out = {"flops": None, "bytes_accessed": None, "bytes_hbm": None}
     # two attempts: on the tunneled dev TPU the remote-compile RPC flakes
     # occasionally, and a swallowed one-off turns a real MFU row into null
     for attempt in range(2):
         try:
-            analysis = jitted_fn.lower(*args, **kwargs).compile().cost_analysis()
+            compiled = jitted_fn.lower(*args, **kwargs).compile()
+            analysis = compiled.cost_analysis()
             if isinstance(analysis, (list, tuple)):
                 analysis = analysis[0]
             flops = float(analysis.get("flops", 0.0))
             out["flops"] = flops if flops > 0 else None
             by = float(analysis.get("bytes accessed", 0.0))
             out["bytes_accessed"] = by if by > 0 else None
+            try:
+                out["bytes_hbm"] = post_fusion_bytes(compiled.as_text())
+            except Exception:
+                out["bytes_hbm"] = None  # serialization quirk: keep flops
             break
         except Exception:
             continue

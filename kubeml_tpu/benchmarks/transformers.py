@@ -67,7 +67,7 @@ def _bench_kavg(module, name: str, sample, labels, *, k: int, steps_cap: int,
     flops = costs["flops"]
     rounds_per_sec = best / samples_per_round
     mfu = mfu_from(flops, rounds_per_sec)
-    ceiling = roofline_mfu(flops, costs["bytes_accessed"])
+    ceiling = roofline_mfu(flops, costs["bytes_hbm"])
     return {
         "metric": f"{name}-train-throughput",
         "value": round(best, 1),
@@ -75,7 +75,8 @@ def _bench_kavg(module, name: str, sample, labels, *, k: int, steps_cap: int,
         "batch": batch,
         "k": k,
         "flops_per_round": flops,
-        "bytes_per_round": costs["bytes_accessed"],
+        "bytes_per_round": costs["bytes_hbm"],
+        "bytes_prefusion": costs["bytes_accessed"],
         "peak_flops": peak_flops(),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "roofline_mfu_ceiling": round(ceiling, 4) if ceiling is not None else None,

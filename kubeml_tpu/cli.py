@@ -104,6 +104,17 @@ def cmd_generate(args) -> int:
     import numpy as np
 
     prompts = np.load(args.datafile, allow_pickle=False)
+    if args.stream:
+        # chunked JSON lines: tokens print as they come off the chip
+        for rec in _client(args).networks().generate(
+                args.network, prompts, max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature, top_k=args.top_k,
+                eos_id=args.eos_id, seed=args.seed, stream=True):
+            if "error" in rec:
+                print(f"error: {rec['error']}", file=sys.stderr)
+                return 1
+            _print(rec)
+        return 0
     out = _client(args).networks().generate(
         args.network, prompts, max_new_tokens=args.max_new_tokens,
         temperature=args.temperature, top_k=args.top_k, eos_id=args.eos_id,
@@ -364,6 +375,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sampling seed (required when --temperature > 0)")
     g.add_argument("--output", "-o", default=None,
                    help="write tokens to this .npy instead of stdout")
+    g.add_argument("--stream", action="store_true",
+                   help="print token deltas as they are generated")
     g.set_defaults(fn=cmd_generate)
 
     d = sub.add_parser("dataset", help="manage datasets")

@@ -57,16 +57,45 @@ class _Networks:
 
     def generate(self, model_id: str, prompts: Any, *, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k=None, eos_id=None,
-                 seed=None) -> dict:
+                 seed=None, prompt_lengths=None, stream: bool = False):
         """Causal-LM sampling against a trained/live job; returns
-        {"tokens": [[...]], "lengths": [...]} (models.generation)."""
+        {"tokens": [[...]], "lengths": [...]} (models.generation).
+
+        ``stream=True`` returns an iterator of JSON-line records instead:
+        ``{"row": i, "tokens": [...]}`` deltas as tokens come off the chip,
+        then a final ``{"done": true, "lengths": [...]}`` (an ``{"error"}``
+        record signals a mid-stream failure). ``prompt_lengths`` serves
+        ragged batches (one true length per padded row)."""
+        from ..api.types import generate_timeout
+
         body = GenerateRequest(
             model_id=model_id, prompts=np.asarray(prompts).tolist(),
             max_new_tokens=max_new_tokens, temperature=temperature,
-            top_k=top_k, eos_id=eos_id, seed=seed)
+            top_k=top_k, eos_id=eos_id, seed=seed,
+            prompt_lengths=prompt_lengths, stream=stream)
+        timeout = generate_timeout(body, floor=max(self.c.timeout, 120))
+        if stream:
+            import json as _json
+
+            r = requests.post(f"{self.c.url}/generate", json=body.to_dict(),
+                              timeout=timeout, stream=True)
+            if r.status_code >= 400:
+                from ..api.errors import error_from_envelope
+
+                raise error_from_envelope(r.content, r.status_code)
+
+            def lines():
+                try:
+                    for line in r.iter_lines():
+                        if line:
+                            yield _json.loads(line)
+                finally:
+                    r.close()  # early-exiting consumers must not leak the socket
+
+            return lines()
         return _check(
             requests.post(f"{self.c.url}/generate", json=body.to_dict(),
-                          timeout=max(self.c.timeout, 120)))
+                          timeout=timeout))
 
 
 class _Datasets:

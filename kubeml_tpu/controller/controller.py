@@ -24,7 +24,7 @@ from ..storage.checkpoint import CheckpointStore
 from ..storage.history import HistoryStore
 from ..storage.service import REQUIRED_FILES, decode_array, parse_multipart
 from ..storage.store import ShardStore
-from ..utils.httpd import Request, Response, Router, Service
+from ..utils.httpd import Request, Response, Router, Service, StreamResponse
 
 
 class Controller:
@@ -88,7 +88,11 @@ class Controller:
 
     def _generate(self, req: Request):
         body = GenerateRequest.parse_request(req.json() or {})
-        return self.scheduler.generate(body)
+        result = self.scheduler.generate(body)
+        if body.stream and not isinstance(result, dict):
+            # continuous-batching stream: chunked JSON lines as tokens land
+            return StreamResponse(result)
+        return result
 
     # --- datasets (reference storageApi.go) ---
 

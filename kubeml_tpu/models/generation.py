@@ -48,6 +48,13 @@ import jax.numpy as jnp
 from .gpt import PAD_ID
 
 
+class GenerationInputError(ValueError):
+    """A USER-input problem in a generation request (bad shapes, capacity
+    overflow, missing rng for sampling). The wire layer maps exactly this
+    type to HTTP 400 — any other ValueError out of the pipeline is a genuine
+    server fault and stays a 500."""
+
+
 class GenerateResult(NamedTuple):
     tokens: jnp.ndarray   # [B, max_new_tokens] int32; PAD_ID after a row's EOS
     lengths: jnp.ndarray  # [B] int32 — tokens generated incl. EOS (or the cap)
@@ -90,13 +97,20 @@ def make_generate_fn(module, *, max_new_tokens: int, temperature: float = 0.0,
     def run(variables, prompt_ids, rng):
         B, Lp = prompt_ids.shape
         cap = getattr(module, "max_len", None)
+        if cap is None:
+            # without a declared capacity the overflow guard below can't run,
+            # and dynamic_update_slice would clamp writes at the cache end and
+            # silently corrupt every token past it — refuse instead
+            raise GenerationInputError(
+                "model exposes no max_len attribute; generation requires a "
+                "declared KV-cache capacity (CausalTransformer sets it)")
         # the LAST sampled token is returned but never written back, so the
         # cache needs Lp + max_new_tokens - 1 slots
-        if cap is not None and Lp + max_new_tokens - 1 > cap:
+        if Lp + max_new_tokens - 1 > cap:
             # shapes are trace-time constants, so this is a clean Python error
             # instead of dynamic_update_slice silently clamping at the cache
             # end and corrupting every token past capacity
-            raise ValueError(
+            raise GenerationInputError(
                 f"prompt ({Lp}) + max_new_tokens ({max_new_tokens}) - 1 "
                 f"exceeds the model's max_len ({cap})")
         cache = init_cache(module, variables, B)
@@ -184,9 +198,9 @@ def generate(module, variables, prompt_ids, *, max_new_tokens: int,
     ``make_generate_fn`` result instead.
     """
     if temperature > 0.0 and rng is None:
-        raise ValueError("temperature > 0 requires an explicit rng "
-                         "(PRNGKey) — otherwise every call returns the "
-                         "same draw")
+        raise GenerationInputError(
+            "temperature > 0 requires an explicit rng (PRNGKey) — otherwise "
+            "every call returns the same draw")
     if rng is None:
         rng = jax.random.PRNGKey(0)
     if temperature <= 0.0:
@@ -240,6 +254,13 @@ def generate_from_request(module, variables, req) -> dict:
         raise KubeMLError(
             "model does not support KV-cache decode (generation needs a "
             "causal LM like CausalTransformer)", 400)
+    lengths = req.prompt_lengths
+    if lengths is not None and any(int(v) != prompts.shape[1] for v in lengths):
+        # ragged batch: decode each row at its true length, grouped by length
+        # so equal-length rows share one program (the LRU caches per shape).
+        # The continuous batcher (kubeml_tpu.serving) serves ragged batches in
+        # one program; this is the one-shot fallback's correct-but-simple form.
+        return _generate_ragged(module, variables, prompts, req)
     try:
         rng = (jax.random.PRNGKey(req.seed) if req.seed is not None
                else None)  # greedy path; sampling enforces a seed upstream
@@ -247,8 +268,42 @@ def generate_from_request(module, variables, req) -> dict:
                        max_new_tokens=req.max_new_tokens,
                        temperature=req.temperature, top_k=req.top_k,
                        eos_id=req.eos_id, rng=rng)
-    except ValueError as e:
-        # the deliberate user-input guards (cache capacity, rng-for-sampling)
+    except GenerationInputError as e:
+        # ONLY the deliberate user-input guards (cache capacity, missing
+        # max_len, rng-for-sampling); any other ValueError is a server fault
         raise KubeMLError(str(e), 400)
     return {"tokens": np.asarray(out.tokens).tolist(),
             "lengths": np.asarray(out.lengths).tolist()}
+
+
+def _generate_ragged(module, variables, prompts, req) -> dict:
+    """One-shot serving of a ragged batch: rows grouped by true length, one
+    ``generate`` call per group, results re-assembled in row order."""
+    import numpy as np
+
+    from ..api.errors import KubeMLError
+
+    B = prompts.shape[0]
+    by_len: dict = {}
+    for i, plen in enumerate(int(v) for v in req.prompt_lengths):
+        by_len.setdefault(plen, []).append(i)
+    tokens: list = [None] * B
+    lengths: list = [None] * B
+    try:
+        for plen, rows in sorted(by_len.items()):
+            sub = prompts[rows, :plen].astype(np.int32)
+            rng = (jax.random.PRNGKey(req.seed) if req.seed is not None else None)
+            if rng is not None:
+                rng = jax.random.fold_in(rng, plen)  # distinct draws per group
+            out = generate(module, variables, sub,
+                           max_new_tokens=req.max_new_tokens,
+                           temperature=req.temperature, top_k=req.top_k,
+                           eos_id=req.eos_id, rng=rng)
+            toks = np.asarray(out.tokens).tolist()
+            lens = np.asarray(out.lengths).tolist()
+            for j, row in enumerate(rows):
+                tokens[row] = toks[j]
+                lengths[row] = lens[j]
+    except GenerationInputError as e:
+        raise KubeMLError(str(e), 400)
+    return {"tokens": tokens, "lengths": lengths}

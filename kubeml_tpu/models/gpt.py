@@ -52,7 +52,7 @@ class CausalSelfAttention(nn.Module):
     rope_theta: float = 10000.0
 
     @nn.compact
-    def __call__(self, x, valid, decode: bool = False):
+    def __call__(self, x, valid, decode: bool = False, positions=None):
         if self.sp_impl not in ("ring", "ulysses"):
             raise ValueError(
                 f"unknown sp_impl {self.sp_impl!r} (valid: 'ring', 'ulysses')"
@@ -92,6 +92,31 @@ class CausalSelfAttention(nn.Module):
             cvalid = self.variable("cache", "valid", jnp.zeros, (B, Lc), jnp.bool_)
             cursor = self.variable("cache", "index",
                                    lambda: jnp.zeros((), jnp.int32))
+            if positions is not None:
+                # PER-ROW cursors [B] (continuous batching, kubeml_tpu.serving):
+                # every slot sits at its own depth, so writes are one-row
+                # scatters at (b, positions[b]) and the causal mask compares
+                # key slots against each row's own position. One-token steps
+                # only — prefill goes through the contiguous scalar path.
+                if L != 1:
+                    raise ValueError("per-row positions decode is one token "
+                                     "per step (L == 1); prefill uses the "
+                                     "scalar-cursor path")
+                if self.rope:
+                    from ..ops.rotary import apply_rope
+
+                    q = apply_rope(q, positions[:, None], self.rope_theta)
+                    k = apply_rope(k, positions[:, None], self.rope_theta)
+                rows = jnp.arange(B)
+                ck.value = ck.value.at[rows, positions].set(k[:, 0])
+                cv.value = cv.value.at[rows, positions].set(v[:, 0])
+                cvalid.value = cvalid.value.at[rows, positions].set(
+                    valid[:, 0].astype(jnp.bool_))
+                k_pos = jnp.arange(Lc)[None, None, None, :]
+                mask = cvalid.value[:, None, None, :] & (
+                    k_pos <= positions[:, None, None, None])
+                out = dot_product_attention(q, ck.value, cv.value, mask=mask)
+                return out_proj(out.reshape(B, L, H * D))
             i0 = cursor.value
             if self.rope:
                 from ..ops.rotary import apply_rope
@@ -164,7 +189,8 @@ class GPTBlock(nn.Module):
     rope_theta: float = 10000.0
 
     @nn.compact
-    def __call__(self, x, valid, train: bool = False, decode: bool = False):
+    def __call__(self, x, valid, train: bool = False, decode: bool = False,
+                 positions=None):
         y = nn.LayerNorm(name="ln1", dtype=jnp.float32,
                          epsilon=self.ln_eps)(x).astype(self.dtype)
         y = CausalSelfAttention(self.num_heads, mesh=self.mesh,
@@ -172,7 +198,8 @@ class GPTBlock(nn.Module):
                                 use_bias=self.attn_bias,
                                 cache_len=self.cache_len,
                                 rope=self.rope, rope_theta=self.rope_theta,
-                                name="attn")(y, valid, decode=decode)
+                                name="attn")(y, valid, decode=decode,
+                                             positions=positions)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(name="ln2", dtype=jnp.float32,
@@ -225,7 +252,7 @@ class CausalTransformer(nn.Module):
 
     @nn.compact
     def __call__(self, token_ids, train: bool = False, decode: bool = False,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False, positions=None):
         token_ids = token_ids.astype(jnp.int32)
         B, L = token_ids.shape
         if decode:
@@ -255,14 +282,22 @@ class CausalTransformer(nn.Module):
             # one feeds the position embedding / exists for parity under rope)
             cursor = self.variable("cache", "index",
                                    lambda: jnp.zeros((), jnp.int32))
-            i0 = cursor.value
-            cursor.value = i0 + L
-            if use_rope:
-                x = x.astype(self.dtype)  # position enters inside attention
+            if positions is not None:
+                # per-row cursors (continuous batching): the shared scalar is
+                # meaningless, each row's position embedding is its own gather
+                if use_rope:
+                    x = x.astype(self.dtype)
+                else:
+                    x = (x + pos[0][positions][:, None, :]).astype(self.dtype)
             else:
-                pos_slice = jax.lax.dynamic_slice(
-                    pos, (0, i0, 0), (1, L, self.embed_dim))
-                x = (x + pos_slice).astype(self.dtype)
+                i0 = cursor.value
+                cursor.value = i0 + L
+                if use_rope:
+                    x = x.astype(self.dtype)  # position enters inside attention
+                else:
+                    pos_slice = jax.lax.dynamic_slice(
+                        pos, (0, i0, 0), (1, L, self.embed_dim))
+                    x = (x + pos_slice).astype(self.dtype)
         elif use_rope:
             x = x.astype(self.dtype)
         else:
@@ -285,13 +320,18 @@ class CausalTransformer(nn.Module):
                     GPTBlock if decode or not self.remat
                     else nn.remat(GPTBlock, static_argnums=(3, 4))
                 )
-                x = block_cls(self.num_heads, self.mlp_ratio, self.dropout,
-                              mesh=self.mesh, sp_impl=self.sp_impl,
-                              dtype=self.dtype, ln_eps=self.ln_eps,
-                              attn_bias=self.attn_bias,
-                              cache_len=self.max_len if decode else 0,
-                              rope=use_rope, rope_theta=self.rope_theta,
-                              name=f"block_{i}")(x, valid, train, decode)
+                block = block_cls(self.num_heads, self.mlp_ratio, self.dropout,
+                                  mesh=self.mesh, sp_impl=self.sp_impl,
+                                  dtype=self.dtype, ln_eps=self.ln_eps,
+                                  attn_bias=self.attn_bias,
+                                  cache_len=self.max_len if decode else 0,
+                                  rope=use_rope, rope_theta=self.rope_theta,
+                                  name=f"block_{i}")
+                # positions only exists on the decode path, which never remats
+                # — keeping the training call positional preserves the remat
+                # wrapper's static_argnums contract
+                x = (block(x, valid, train, decode, positions=positions)
+                     if decode else block(x, valid, train, decode))
         x = nn.LayerNorm(name="ln_f", dtype=jnp.float32,
                          epsilon=self.ln_eps)(x).astype(self.dtype)
         if return_hidden:

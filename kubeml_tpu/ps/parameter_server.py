@@ -28,7 +28,7 @@ import numpy as np
 
 from ..api.config import Config, get_config
 from ..api.errors import JobNotFoundError, KubeMLError
-from ..api.types import JobState, JobStateEnum, MetricUpdate, TrainTask
+from ..api.types import JobState, JobStateEnum, MetricUpdate, TrainTask, generate_timeout
 from ..engine.job import TrainJob
 from ..functions.registry import FunctionRegistry
 from ..storage.checkpoint import FINAL_TAG, CheckpointStore
@@ -40,6 +40,10 @@ log = logging.getLogger("kubeml.ps")
 
 # finished-job serving cache: full weight pytrees are big, keep only a few
 SERVING_CACHE_SIZE = 4
+
+# resident continuous-batching decoders: each holds a slots x max_len KV slab
+# in HBM, so keep fewer than the weight cache
+DECODER_CACHE_SIZE = 2
 
 # Seconds the job thread waits for the scheduler's parallelism answer before
 # keeping its current parallelism (the reference blocks forever on schedulerCh;
@@ -88,6 +92,7 @@ class ParameterServer:
         self._monitor: Optional[threading.Thread] = None  # standalone liveness watch
         self._serving_cache: Dict[str, tuple] = {}  # (model, vars, ckpt mtime)
         self._socket_cache: Dict[str, tuple] = {}  # (model, vars, epoch version)
+        self._decoders: Dict[str, tuple] = {}  # (BatchingDecoder, ckpt mtime)
         self._ckpt_store = CheckpointStore(config=self.cfg)
         self._lock = threading.RLock()
         # multi-host: the PS runs on process 0 and announces each job to the
@@ -614,10 +619,16 @@ class ParameterServer:
         finally:
             self.metrics.task_finished("inference")
 
-    def generate(self, model_id: str, req) -> dict:
+    def generate(self, model_id: str, req):
         """`/generate`: autoregressive sampling from a causal-LM job (live
         in-process, live standalone via its runner, or finished via the final
-        checkpoint). Extension — the reference serves forward passes only."""
+        checkpoint). Extension — the reference serves forward passes only.
+
+        Finished-checkpoint serving routes through the continuous batcher
+        (kubeml_tpu.serving): concurrent requests coalesce into one resident
+        batched decode loop instead of one program execution each. Returns a
+        dict, or — when ``req.stream`` — a generator of JSON-line records
+        (``{"row", "tokens"}`` deltas, then ``{"done", "lengths"}``)."""
         from ..api.types import GenerateRequest
 
         if not isinstance(req, GenerateRequest):
@@ -629,11 +640,17 @@ class ParameterServer:
 
             from ..api.errors import error_from_envelope
 
-            r = requests.post(f"{record.url}/generate", json=req.to_dict(),
-                              timeout=120)
+            # the runner serves one-shot only: forward without stream and
+            # re-wrap below. First call on a new knob/shape combination pays
+            # a ~20-27s XLA compile before any decoding; scale the budget
+            # with the work so big-but-healthy requests don't surface as
+            # transport failures
+            fwd = {**req.to_dict(), "stream": False}
+            r = requests.post(f"{record.url}/generate", json=fwd,
+                              timeout=generate_timeout(req))
             if r.status_code >= 400:
                 raise error_from_envelope(r.content, r.status_code)
-            return r.json()
+            return self._maybe_stream(r.json(), req)
         if record is not None:
             if record.job is None:
                 raise KubeMLError(f"job {model_id} is still starting", 503)
@@ -642,17 +659,108 @@ class ParameterServer:
                     f"job {model_id}'s engine does not serve generation", 400)
             self.metrics.task_started("inference")
             try:
-                return record.job.generate(req)
+                return self._maybe_stream(record.job.generate(req), req)
+            finally:
+                self.metrics.task_finished("inference")
+        model, variables = self._load_serving(model_id)
+        decoder = self._get_decoder(model_id, model, variables)
+        if decoder is not None:
+            entry = decoder.submit(req)
+            if req.stream:
+                return self._metered_stream(decoder.stream(entry))
+            self.metrics.task_started("inference")
+            try:
+                return decoder.wait(entry, timeout=generate_timeout(req))
             finally:
                 self.metrics.task_finished("inference")
         from ..models.generation import generate_from_request
 
-        model, variables = self._load_serving(model_id)
         self.metrics.task_started("inference")
         try:
-            return generate_from_request(model.module, variables, req)
+            return self._maybe_stream(
+                generate_from_request(model.module, variables, req), req)
         finally:
             self.metrics.task_finished("inference")
+
+    @staticmethod
+    def _maybe_stream(result: dict, req):
+        """Adapt a one-shot result to the streaming wire shape when the
+        client asked to stream but the serving path is one-shot."""
+        if not req.stream:
+            return result
+
+        def lines():
+            for i, toks in enumerate(result["tokens"]):
+                yield {"row": i, "tokens": toks[: result["lengths"][i]]}
+            yield {"done": True, "lengths": result["lengths"]}
+
+        return lines()
+
+    def _metered_stream(self, gen):
+        self.metrics.task_started("inference")
+
+        def wrapped():
+            try:
+                yield from gen
+            finally:
+                self.metrics.task_finished("inference")
+
+        return wrapped()
+
+    def _get_decoder(self, model_id: str, model, variables):
+        """The continuous-batching decoder for a finished checkpoint, or None
+        when the model can't be slab-decoded (no per-row positions support)
+        or batching is disabled. Invalidated when the checkpoint changes."""
+        if not self.cfg.serving_batcher:
+            return None
+        module = getattr(model, "module", None)
+        if module is None or getattr(module, "max_len", None) is None:
+            return None
+        import inspect
+
+        try:
+            params = inspect.signature(module.__call__).parameters
+        except (TypeError, ValueError):
+            return None
+        if "decode" not in params or "positions" not in params:
+            return None
+        if getattr(module, "moe_every", 0):
+            return None  # MoE decode serves through the one-shot path
+        mtime = self._serving_cache.get(model_id)
+        mtime = mtime[2] if mtime else None
+        with self._lock:
+            cached = self._decoders.get(model_id)
+            if cached is not None and cached[1] == mtime:
+                return cached[0]
+        from ..serving import BatchingDecoder
+
+        decoder = BatchingDecoder(
+            module, variables, slots=self.cfg.serving_slots,
+            chunk_steps=self.cfg.serving_chunk_steps, name=model_id)
+        stale = []
+        with self._lock:
+            # double-checked: a racing thread may have built one meanwhile —
+            # theirs may already carry traffic, ours is guaranteed unused
+            current = self._decoders.get(model_id)
+            if current is not None and current[1] == mtime:
+                stale.append(decoder)
+                decoder = current[0]
+            else:
+                if current is not None:
+                    stale.append(current[0])
+                self._decoders[model_id] = (decoder, mtime)
+                while len(self._decoders) > DECODER_CACHE_SIZE:
+                    # dicts iterate in insertion order: evict the oldest entry
+                    oldest = next(iter(self._decoders))
+                    stale.append(self._decoders.pop(oldest)[0])
+        for d in stale:
+            try:
+                # graceful: in-flight requests on a displaced decoder finish;
+                # only new submissions are refused
+                d.retire()
+            except Exception:
+                log.exception("retiring stale decoder failed")
+        return decoder
 
     def _infer_from_socket(self, model_id: str, record, data) -> Optional[list]:
         """Serve a live standalone job from its runner's tensor socket; None

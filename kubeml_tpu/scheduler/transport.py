@@ -42,7 +42,12 @@ class SchedulerAPI:
 
     def _generate(self, req: Request):
         body = GenerateRequest.parse_request(req.json() or {})
-        return self.scheduler.generate(body)
+        result = self.scheduler.generate(body)
+        if body.stream and not isinstance(result, dict):
+            from ..utils.httpd import StreamResponse
+
+            return StreamResponse(result)
+        return result
 
     def _job(self, req: Request):
         self.scheduler.update_job(TrainTask.parse_request(req.json() or {}))
@@ -92,10 +97,32 @@ class SchedulerClient:
         )
         return r["predictions"]
 
-    def generate(self, req: "GenerateRequest") -> dict:
+    def generate(self, req: "GenerateRequest"):
+        from ..api.types import generate_timeout
+
+        timeout = generate_timeout(req, floor=max(self.timeout, 120))
+        if req.stream:
+            import json as _json
+
+            from ..api.errors import error_from_envelope
+
+            r = requests.post(f"{self.url}/generate", json=req.to_dict(),
+                              timeout=timeout, stream=True)
+            if r.status_code >= 400:
+                raise error_from_envelope(r.content, r.status_code)
+
+            def lines():
+                try:
+                    for line in r.iter_lines():
+                        if line:
+                            yield _json.loads(line)
+                finally:
+                    r.close()  # early-exiting consumers must not leak the socket
+
+            return lines()
         return _check(
             requests.post(f"{self.url}/generate", json=req.to_dict(),
-                          timeout=max(self.timeout, 120))
+                          timeout=timeout)
         )
 
     def update_job(self, task: TrainTask) -> None:

@@ -1,0 +1,526 @@
+"""Continuous batching for KV-cache decode (the TPU serving engine).
+
+One resident "slab" of S decode slots lives on device: per-layer KV caches
+``[S, max_len, H, D]``, per-slot cursors, liveness, sampling knobs, and PRNG
+keys. Requests are split into rows; each row is prefilled (one program per
+prompt-length bucket), admitted into a free slot, and then ALL live slots
+advance together through one jitted multi-token step program. Admission and
+eviction happen at chunk boundaries — the decode loop never recompiles as
+traffic changes.
+
+Why this shape on TPU:
+
+* Decode is HBM-bound (every step re-reads the weights), so stepping 8 slots
+  costs ~the same wall clock as stepping 1 — batched decode is nearly free
+  throughput (chip-measured 14x from batch 1 -> 16, round 3).
+* All shapes are static: S, max_len, and the chunk length T are compile-time
+  constants; per-row depth differences are runtime data (a ``positions``
+  vector), so XLA compiles exactly three programs (prefill per bucket, admit,
+  step-chunk) for the life of the server.
+* Per-row sampling knobs (temperature / top_k / eos) are runtime tensors, not
+  trace constants — one program serves every knob combination, killing the
+  compile-per-knob DoS surface the one-shot path has
+  (``models.generation.make_generate_fn`` keys its LRU by knobs).
+* The scan emits ``[T, S]`` token blocks; the host fetches values (a real
+  barrier on this platform — see utils docs), distributes tokens to request
+  buffers, streams deltas to subscribers, and refills free slots.
+
+The reference has no serving runtime at all to compare against; the closest
+analogue is its one-pod-per-function Fission serving
+(/root/reference/ml/pkg/controller/api.go:121-160), which this replaces with
+one resident program.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.errors import KubeMLError
+from ..models.generation import GenerationInputError, init_cache
+from ..models.gpt import PAD_ID
+
+log = logging.getLogger("kubeml.serving")
+
+# Static width of the on-device top-k scratch: per-row runtime top_k values
+# are applied by thresholding against the k-th of these. Requests cap top_k
+# at this bound (api.types.GENERATE_MAX_TOP_K mirrors it on the wire).
+TOP_K_MAX = 128
+
+_F32_NEG_INF = jnp.finfo(jnp.float32).min
+
+
+class DecoderClosed(KubeMLError):
+    def __init__(self):
+        super().__init__("decoder is shut down", 503)
+
+
+def _sample_rows(logits, keys, temp, topk):
+    """One next-token draw per row with PER-ROW runtime knobs.
+
+    logits [S, V] f32, keys [S, 2] uint32, temp [S] f32 (<=0 = greedy),
+    topk [S] int32 (0 = off). Greedy rows compute-and-discard the sampled
+    branch — that keeps the program knob-free (one compile for all traffic).
+    """
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
+    kwide = min(TOP_K_MAX, V)
+    vals = jax.lax.top_k(scaled, kwide)[0]  # [S, kwide] sorted desc
+    kth = jnp.take_along_axis(
+        vals, jnp.clip(topk - 1, 0, kwide - 1)[:, None], axis=1)  # [S, 1]
+    masked = jnp.where((topk > 0)[:, None] & (scaled < kth),
+                       _F32_NEG_INF, scaled)
+    sampled = jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
+    return jnp.where(temp <= 0.0, greedy, sampled)
+
+
+def _split_rows(keys):
+    """Per-row (use, next) key split. keys [S, 2] uint32."""
+    pairs = jax.vmap(lambda k: jax.random.split(k, 2))(keys)  # [S, 2, 2]
+    return pairs[:, 0], pairs[:, 1]
+
+
+class _Slab:
+    """The device-resident decode state (a plain pytree container)."""
+
+    def __init__(self, cache, tok, pos, live, remaining, keys, temp, topk, eos):
+        self.cache = cache          # per-layer KV pytree, [S, ...] leaves
+        self.tok = tok              # [S] i32 next token to feed
+        self.pos = pos              # [S] i32 cache write position of tok
+        self.live = live            # [S] bool
+        self.remaining = remaining  # [S] i32 emissions still allowed
+        self.keys = keys            # [S, 2] u32 per-slot PRNG state
+        self.temp = temp            # [S] f32
+        self.topk = topk            # [S] i32, 0 = off
+        self.eos = eos              # [S] i32, -1 = off
+
+
+jax.tree_util.register_pytree_node(
+    _Slab,
+    lambda s: ((s.cache, s.tok, s.pos, s.live, s.remaining, s.keys, s.temp,
+                s.topk, s.eos), None),
+    lambda _, c: _Slab(*c),
+)
+
+
+@dataclass
+class _Row:
+    """One admitted decode row (a request of batch B becomes B rows)."""
+
+    entry: "_Entry"
+    index: int
+    prompt: np.ndarray  # [plen] int32, dense
+    max_new: int
+    temp: float
+    topk: int   # 0 = off
+    eos: int    # -1 = off
+    key: np.ndarray  # [2] uint32 (zeros for greedy rows — never used)
+    out: List[int] = field(default_factory=list)
+    done: bool = False
+    canceled: bool = False  # abandoned by its waiter: free the slot ASAP
+
+
+@dataclass
+class _Entry:
+    """One submitted request: rows + completion/stream plumbing."""
+
+    rows: List[_Row]
+    max_new: int
+    stream_q: Optional[queue.Queue] = None
+    done_evt: threading.Event = field(default_factory=threading.Event)
+    error: Optional[Exception] = None
+
+    def finished(self) -> bool:
+        return all(r.done for r in self.rows)
+
+    def result(self) -> dict:
+        tokens = [r.out + [PAD_ID] * (self.max_new - len(r.out))
+                  for r in self.rows]
+        return {"tokens": tokens, "lengths": [len(r.out) for r in self.rows]}
+
+
+def _pow2_bucket(n: int, lo: int, hi: int) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return min(b, hi)
+
+
+class BatchingDecoder:
+    """Slot-based continuous batching over one causal-LM module.
+
+    ``submit`` is thread-safe and returns immediately; ``wait`` blocks for the
+    full result; ``stream`` yields per-chunk token deltas as they come off the
+    chip. One background thread owns the device loop.
+    """
+
+    def __init__(self, module, variables, *, slots: int = 8,
+                 chunk_steps: int = 8, bucket_min: int = 16,
+                 name: str = "decoder"):
+        cap = getattr(module, "max_len", None)
+        if cap is None:
+            raise GenerationInputError(
+                "model exposes no max_len attribute; batched decode requires "
+                "a declared KV-cache capacity")
+        self.module = module
+        self.max_len = int(cap)
+        self.slots = int(slots)
+        self.chunk_steps = int(chunk_steps)
+        self.bucket_min = int(bucket_min)
+        self.name = name
+        self._variables = jax.device_put(variables)
+        self._pending: deque = deque()
+        self._slot_rows: List[Optional[_Row]] = [None] * self.slots
+        self._free = list(range(self.slots))
+        self._cond = threading.Condition()
+        self._closed = False
+        self._retired = False
+        self._slab = None
+        self._prefill_fns: Dict[int, Any] = {}
+        self._thread: Optional[threading.Thread] = None
+        # programs are built lazily on the engine thread (first submit)
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        self._step = jax.jit(self._step_impl, donate_argnums=donate)
+        self._admit_fn = jax.jit(self._admit_impl, donate_argnums=donate)
+
+    # --- device programs ---
+
+    def _apply_step(self, variables, cache, tok, pos):
+        logits, vs = self.module.apply(
+            {**variables, "cache": cache}, tok[:, None], decode=True,
+            positions=pos, mutable=["cache"])
+        return logits[:, -1].astype(jnp.float32), vs["cache"]
+
+    def _step_impl(self, variables, slab):
+        """Advance every slot ``chunk_steps`` tokens; emit [T, S] blocks."""
+
+        def one(s, _):
+            logits, cache = self._apply_step(variables, s.cache, s.tok, s.pos)
+            use, nxt_keys = _split_rows(s.keys)
+            nxt = _sample_rows(logits, use, s.temp, s.topk)
+            was_live = s.live
+            hit_eos = (s.eos >= 0) & (nxt == s.eos)
+            rem = s.remaining - was_live.astype(jnp.int32)
+            live = was_live & ~hit_eos & (rem > 0)
+            out = jnp.where(was_live, nxt, PAD_ID)
+            # dead rows freeze: keep feeding their last token at a frozen
+            # (in-bounds) position — their writes only touch their own slot,
+            # which the next admit overwrites wholesale
+            feed = jnp.where(live, nxt, s.tok)
+            pos = jnp.where(live, s.pos + 1, s.pos)
+            s2 = _Slab(cache, feed, pos, live, rem, nxt_keys, s.temp, s.topk,
+                       s.eos)
+            return s2, (out, was_live)
+
+        slab, (toks, emitted) = jax.lax.scan(
+            one, slab, None, length=self.chunk_steps)
+        return slab, toks, emitted
+
+    def _make_prefill(self, bucket: int):
+        def prefill(variables, prompt, plen):
+            cache = init_cache(self.module, variables, 1)
+            logits, vs = self.module.apply(
+                {**variables, "cache": cache}, prompt, decode=True,
+                mutable=["cache"])
+            # bucket padding means positions >= plen hold garbage K/V; the
+            # admit program trims their validity. The next-token logits come
+            # from the last REAL prompt token, a runtime gather at plen-1.
+            last = logits[0, plen - 1].astype(jnp.float32)
+            return vs["cache"], last
+
+        return jax.jit(prefill)
+
+    def _admit_impl(self, variables, slab, row_cache, last_logits, slot, plen,
+                    max_new, temp, topk, eos, key):
+        """Insert a prefilled row into ``slot`` and sample its first token."""
+        Lc = self.max_len
+        trim = jnp.arange(Lc) < plen
+
+        def insert(slab_leaf, row_leaf):
+            if getattr(slab_leaf, "ndim", 0) == 0:
+                return slab_leaf  # scalar cursor leaves: unused in slab mode
+            if row_leaf.dtype == jnp.bool_ and row_leaf.ndim == 2:
+                row_leaf = row_leaf & trim[None, :]  # per-layer "valid"
+            start = (slot,) + (0,) * (row_leaf.ndim - 1)
+            return jax.lax.dynamic_update_slice(slab_leaf, row_leaf, start)
+
+        cache = jax.tree.map(insert, slab.cache, row_cache)
+        use, nxt_key = jax.random.split(key)
+        first = _sample_rows(last_logits[None], use[None],
+                             temp[None], topk[None])[0]
+        hit_eos = (eos >= 0) & (first == eos)
+        live0 = jnp.logical_and(max_new > 1, ~hit_eos)
+
+        def put(vec, val):
+            return vec.at[slot].set(val.astype(vec.dtype))
+
+        slab2 = _Slab(
+            cache,
+            put(slab.tok, first),
+            put(slab.pos, plen),
+            put(slab.live, live0),
+            put(slab.remaining, max_new - 1),
+            slab.keys.at[slot].set(nxt_key),
+            put(slab.temp, temp),
+            put(slab.topk, topk),
+            put(slab.eos, eos),
+        )
+        return slab2, first, live0
+
+    def _init_slab(self) -> _Slab:
+        S = self.slots
+        cache = init_cache(self.module, self._variables, S)
+        return _Slab(
+            cache,
+            jnp.zeros((S,), jnp.int32),
+            jnp.zeros((S,), jnp.int32),
+            jnp.zeros((S,), bool),
+            jnp.zeros((S,), jnp.int32),
+            jnp.tile(jax.random.PRNGKey(0)[None], (S, 1)),
+            jnp.ones((S,), jnp.float32),
+            jnp.zeros((S,), jnp.int32),
+            jnp.full((S,), -1, jnp.int32),
+        )
+
+    # --- public API ---
+
+    def submit(self, req) -> _Entry:
+        """Validate and enqueue a GenerateRequest; returns its entry."""
+        prompts = np.asarray(req.prompts)
+        if prompts.ndim != 2 or not np.issubdtype(prompts.dtype, np.integer):
+            raise KubeMLError(
+                "prompts must be a [batch, prompt_len] integer token array", 400)
+        B, width = prompts.shape
+        lens = ([int(v) for v in req.prompt_lengths]
+                if req.prompt_lengths is not None else [width] * B)
+        if req.top_k is not None and req.top_k > TOP_K_MAX:
+            raise KubeMLError(
+                f"top_k exceeds the serving bound ({TOP_K_MAX})", 400)
+        for plen in lens:
+            if plen + req.max_new_tokens - 1 > self.max_len:
+                raise KubeMLError(
+                    f"prompt ({plen}) + max_new_tokens ({req.max_new_tokens})"
+                    f" - 1 exceeds the model's max_len ({self.max_len})", 400)
+        base_key = (jax.random.PRNGKey(req.seed) if req.seed is not None
+                    else None)
+        rows = []
+        entry = _Entry(rows=rows, max_new=req.max_new_tokens,
+                       stream_q=queue.Queue() if req.stream else None)
+        for i in range(B):
+            key = (np.asarray(jax.random.fold_in(base_key, i))
+                   if base_key is not None
+                   else np.zeros((2,), np.uint32))
+            rows.append(_Row(
+                entry=entry, index=i, prompt=prompts[i, :lens[i]].astype(np.int32),
+                max_new=req.max_new_tokens,
+                temp=float(req.temperature),
+                topk=int(req.top_k or 0),
+                eos=int(req.eos_id) if req.eos_id is not None else -1,
+                key=key,
+            ))
+        with self._cond:
+            if self._closed or self._retired:
+                raise DecoderClosed()
+            self._pending.extend(rows)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, name=f"decode-{self.name}", daemon=True)
+                self._thread.start()
+            self._cond.notify_all()
+        return entry
+
+    def wait(self, entry: _Entry, timeout: Optional[float] = None) -> dict:
+        if not entry.done_evt.wait(timeout):
+            # nobody will read the result: cancel so the rows stop holding
+            # decode slots (they would otherwise run to max_new_tokens and
+            # starve live traffic behind discarded work)
+            self.cancel(entry)
+            raise KubeMLError("generation timed out", 504)
+        if entry.error is not None:
+            raise entry.error
+        return entry.result()
+
+    def cancel(self, entry: _Entry) -> None:
+        """Abandon a request: queued rows leave the pending queue now;
+        admitted rows are evicted from their slots at the next chunk
+        boundary."""
+        with self._cond:
+            for row in entry.rows:
+                row.canceled = True
+            self._pending = deque(r for r in self._pending if not r.canceled)
+            self._cond.notify_all()
+
+    def stream(self, entry: _Entry):
+        """Yield ``{"row": i, "tokens": [...]}`` deltas, then a final
+        ``{"done": true, "lengths": [...]}``; raises the entry's error."""
+        while True:
+            item = entry.stream_q.get()
+            if item is None:
+                if entry.error is not None:
+                    raise entry.error
+                yield {"done": True,
+                       "lengths": [len(r.out) for r in entry.rows]}
+                return
+            yield item
+
+    def close(self) -> None:
+        """Hard shutdown: fails everything queued or in flight."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._fail_all(DecoderClosed())
+
+    def retire(self) -> None:
+        """Graceful shutdown for cache displacement: new submissions are
+        rejected, in-flight requests finish normally, then the engine thread
+        exits and the slab is freed."""
+        with self._cond:
+            self._retired = True
+            self._cond.notify_all()
+
+    # --- engine loop (one thread owns the device state) ---
+
+    def _busy(self) -> bool:
+        return any(r is not None for r in self._slot_rows)
+
+    def _loop(self) -> None:
+        try:
+            self._slab = self._init_slab()
+        except Exception as e:  # init/compile failure fails all waiters
+            log.exception("%s: slab init failed", self.name)
+            self._fail_all(e)
+            return
+        while True:
+            with self._cond:
+                while not self._closed and not self._pending and not self._busy():
+                    if self._retired:
+                        self._slab = None  # free the KV slab's HBM
+                        return
+                    self._cond.wait()
+                if self._closed:
+                    return
+                admits = []
+                while self._free and self._pending:
+                    admits.append((self._free.pop(0), self._pending.popleft()))
+            try:
+                for slot, row in admits:
+                    if not row.canceled:
+                        self._admit(slot, row)
+                    else:
+                        with self._cond:
+                            self._free.append(slot)
+                self._evict_canceled()
+                if self._busy():
+                    self._chunk()
+            except Exception as e:
+                log.exception("%s: decode loop failed", self.name)
+                self._fail_all(e)
+                with self._cond:
+                    if self._closed:
+                        return
+                    # reset device state so later traffic gets a clean slab
+                    self._slot_rows = [None] * self.slots
+                    self._free = list(range(self.slots))
+                try:
+                    self._slab = self._init_slab()
+                except Exception:
+                    with self._cond:
+                        self._closed = True
+                    return
+
+    def _admit(self, slot: int, row: _Row) -> None:
+        plen = len(row.prompt)
+        bucket = _pow2_bucket(max(plen, 1), self.bucket_min, self.max_len)
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            fn = self._prefill_fns.setdefault(bucket, self._make_prefill(bucket))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :plen] = row.prompt
+        row_cache, last = fn(self._variables, jnp.asarray(padded),
+                             jnp.int32(plen))
+        self._slab, first, live0 = self._admit_fn(
+            self._variables, self._slab, row_cache, last,
+            jnp.int32(slot), jnp.int32(plen), jnp.int32(row.max_new),
+            jnp.float32(row.temp), jnp.int32(row.topk), jnp.int32(row.eos),
+            jnp.asarray(row.key))
+        first = int(first)  # value fetch = the platform's only real barrier
+        row.out.append(first)
+        self._emit_delta(row, [first])
+        if not bool(live0):
+            self._complete_row(slot, row)
+        else:
+            self._slot_rows[slot] = row
+
+    def _chunk(self) -> None:
+        self._slab, toks, emitted = self._step(self._variables, self._slab)
+        toks = np.asarray(toks)        # [T, S]
+        emitted = np.asarray(emitted)  # [T, S]
+        for slot, row in enumerate(self._slot_rows):
+            if row is None:
+                continue
+            fresh: List[int] = []
+            for t in range(toks.shape[0]):
+                if not emitted[t, slot]:
+                    break
+                tok = int(toks[t, slot])
+                fresh.append(tok)
+                row.out.append(tok)
+                if ((row.eos >= 0 and tok == row.eos)
+                        or len(row.out) >= row.max_new):
+                    break
+            if fresh:
+                self._emit_delta(row, fresh)
+            if ((row.eos >= 0 and row.out and row.out[-1] == row.eos)
+                    or len(row.out) >= row.max_new):
+                self._complete_row(slot, row)
+
+    def _evict_canceled(self) -> None:
+        """Free slots whose rows were abandoned (wait() timeout / cancel):
+        the device-side live flag drops so the slot stops burning steps."""
+        for slot, row in enumerate(self._slot_rows):
+            if row is not None and row.canceled:
+                self._slab.live = self._slab.live.at[slot].set(False)
+                row.done = True
+                self._slot_rows[slot] = None
+                with self._cond:
+                    self._free.append(slot)
+
+    def _complete_row(self, slot: int, row: _Row) -> None:
+        row.done = True
+        self._slot_rows[slot] = None
+        with self._cond:
+            self._free.append(slot)
+        entry = row.entry
+        if entry.finished():
+            entry.done_evt.set()
+            if entry.stream_q is not None:
+                entry.stream_q.put(None)
+
+    def _emit_delta(self, row: _Row, tokens: List[int]) -> None:
+        q = row.entry.stream_q
+        if q is not None:
+            q.put({"row": row.index, "tokens": tokens})
+
+    def _fail_all(self, error: Exception) -> None:
+        with self._cond:
+            rows = list(self._pending) + [r for r in self._slot_rows if r]
+            self._pending.clear()
+            self._slot_rows = [None] * self.slots
+            self._free = list(range(self.slots))
+        for row in rows:
+            row.done = True
+            entry = row.entry
+            if entry.error is None:
+                entry.error = error
+            entry.done_evt.set()
+            if entry.stream_q is not None:
+                entry.stream_q.put(None)

@@ -61,6 +61,18 @@ class Response:
         self.content_type = content_type
 
 
+class StreamResponse(Response):
+    """Chunked-transfer response: ``items`` yields JSON-serializable objects
+    (each becomes one newline-terminated JSON line) or raw ``bytes``. Errors
+    raised mid-stream can't change the status line (headers are gone), so
+    they surface as a final ``{"error": ...}`` line before close — clients
+    must check the last line."""
+
+    def __init__(self, items, content_type: str = "application/x-ndjson"):
+        super().__init__(body=None, status=200, content_type=content_type)
+        self.items = items
+
+
 class Router:
     def __init__(self, name: str):
         self.name = name
@@ -108,6 +120,8 @@ class Service:
                 log.debug("%s %s", router.name, fmt % args)
 
             def _respond(self, resp: Response):
+                if isinstance(resp, StreamResponse):
+                    return self._respond_stream(resp)
                 if isinstance(resp.body, (bytes, bytearray)):
                     payload = bytes(resp.body)
                 else:
@@ -117,6 +131,30 @@ class Service:
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
+
+            def _chunk(self, data: bytes):
+                self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+
+            def _respond_stream(self, resp: StreamResponse):
+                self.send_response(resp.status)
+                self.send_header("Content-Type", resp.content_type)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for item in resp.items:
+                        data = (bytes(item) if isinstance(item, (bytes, bytearray))
+                                else json.dumps(item).encode() + b"\n")
+                        if data:
+                            self._chunk(data)
+                        self.wfile.flush()
+                except BrokenPipeError:
+                    return  # client went away mid-stream
+                except KubeMLError as e:
+                    self._chunk(json.dumps(e.to_dict()).encode() + b"\n")
+                except Exception as e:
+                    log.exception("%s: error mid-stream", router.name)
+                    self._chunk(json.dumps({"error": str(e), "code": 500}).encode() + b"\n")
+                self.wfile.write(b"0\r\n\r\n")
 
             def _handle(self, method: str):
                 try:

@@ -1,0 +1,311 @@
+"""Continuous-batching decode engine (kubeml_tpu.serving.batcher).
+
+Correctness bar: the slab engine must be TOKEN-IDENTICAL to the one-shot
+``models.generation.generate`` path for greedy decode — same model, same
+prompts, any interleaving of requests — because both implement the same
+argmax chain. Sampling rows are checked for reproducibility and vocab
+bounds. Wire-level: stream chunks must concatenate to the final result.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubeml_tpu.api.errors import KubeMLError
+from kubeml_tpu.api.types import GenerateRequest
+from kubeml_tpu.models.generation import generate
+from kubeml_tpu.models.gpt import PAD_ID, CausalTransformer
+from kubeml_tpu.serving.batcher import BatchingDecoder
+
+VOCAB = 101
+
+
+def tiny(pos="learned"):
+    return CausalTransformer(vocab_size=VOCAB, max_len=64, embed_dim=64,
+                             depth=2, num_heads=4, pos=pos)
+
+
+@pytest.fixture(scope="module", params=["learned", "rope"])
+def served(request):
+    m = tiny(request.param)
+    variables = m.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    return m, variables
+
+
+def one_shot(m, variables, prompt, n, **kw):
+    out = generate(m, variables, np.asarray(prompt, np.int32), max_new_tokens=n, **kw)
+    return np.asarray(out.tokens), np.asarray(out.lengths)
+
+
+def test_batched_greedy_matches_one_shot(served):
+    m, variables = served
+    dec = BatchingDecoder(m, variables, slots=4, chunk_steps=4)
+    try:
+        p1 = np.arange(1, 9, dtype=np.int32)[None]
+        p2 = (np.arange(1, 6, dtype=np.int32) * 7 % VOCAB)[None]
+        ref1, _ = one_shot(m, variables, p1, 10)
+        ref2, _ = one_shot(m, variables, p2, 7)
+        e1 = dec.submit(GenerateRequest(prompts=p1.tolist(), max_new_tokens=10))
+        e2 = dec.submit(GenerateRequest(prompts=p2.tolist(), max_new_tokens=7))
+        r1 = dec.wait(e1, timeout=300)
+        r2 = dec.wait(e2, timeout=300)
+        assert r1["tokens"][0] == ref1[0].tolist()
+        assert r2["tokens"][0] == ref2[0].tolist()
+        assert r1["lengths"] == [10] and r2["lengths"] == [7]
+    finally:
+        dec.close()
+
+
+def test_more_rows_than_slots_queue_and_match(served):
+    """12 rows through 3 slots: every row still token-identical to one-shot."""
+    m, variables = served
+    dec = BatchingDecoder(m, variables, slots=3, chunk_steps=4)
+    try:
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(1, VOCAB, size=(1, int(l))).astype(np.int32)
+                   for l in rng.integers(3, 12, size=12)]
+        refs = [one_shot(m, variables, p, 6)[0][0].tolist() for p in prompts]
+        entries = [dec.submit(GenerateRequest(prompts=p.tolist(), max_new_tokens=6))
+                   for p in prompts]
+        for entry, ref in zip(entries, refs):
+            assert dec.wait(entry, timeout=600)["tokens"][0] == ref
+    finally:
+        dec.close()
+
+
+def test_ragged_batch_via_prompt_lengths(served):
+    m, variables = served
+    dec = BatchingDecoder(m, variables, slots=4, chunk_steps=4)
+    try:
+        p1 = np.arange(1, 9, dtype=np.int32)
+        p2 = (np.arange(1, 5, dtype=np.int32) * 5 % VOCAB)
+        wide = np.zeros((2, 8), np.int32)
+        wide[0] = p1
+        wide[1, :4] = p2
+        ref1, _ = one_shot(m, variables, p1[None], 5)
+        ref2, _ = one_shot(m, variables, p2[None], 5)
+        entry = dec.submit(GenerateRequest(
+            prompts=wide.tolist(), prompt_lengths=[8, 4], max_new_tokens=5))
+        out = dec.wait(entry, timeout=300)
+        assert out["tokens"][0] == ref1[0].tolist()
+        assert out["tokens"][1] == ref2[0].tolist()
+    finally:
+        dec.close()
+
+
+def test_eos_masking_matches_one_shot(served):
+    """Pick the first greedily-emitted token as EOS: the row must stop there,
+    pad after, and report the same length as the one-shot path."""
+    m, variables = served
+    p = np.arange(2, 10, dtype=np.int32)[None]
+    ref, _ = one_shot(m, variables, p, 8)
+    eos = int(ref[0, 2])  # third emitted token
+    ref_eos, ref_len = one_shot(m, variables, p, 8, eos_id=eos)
+    dec = BatchingDecoder(m, variables, slots=2, chunk_steps=8)
+    try:
+        entry = dec.submit(GenerateRequest(prompts=p.tolist(), max_new_tokens=8,
+                                           eos_id=eos))
+        out = dec.wait(entry, timeout=300)
+        assert out["tokens"][0] == ref_eos[0].tolist()
+        assert out["lengths"] == [int(ref_len[0])]
+        assert all(t == PAD_ID for t in out["tokens"][0][out["lengths"][0]:])
+    finally:
+        dec.close()
+
+
+def test_single_token_and_immediate_eos(served):
+    m, variables = served
+    p = np.arange(1, 6, dtype=np.int32)[None]
+    ref, _ = one_shot(m, variables, p, 1)
+    dec = BatchingDecoder(m, variables, slots=2, chunk_steps=2)
+    try:
+        entry = dec.submit(GenerateRequest(prompts=p.tolist(), max_new_tokens=1))
+        out = dec.wait(entry, timeout=300)
+        assert out["tokens"][0] == ref[0].tolist() and out["lengths"] == [1]
+        # first emitted token == eos: done at admit, length 1
+        eos = int(ref[0, 0])
+        entry = dec.submit(GenerateRequest(prompts=p.tolist(), max_new_tokens=6,
+                                           eos_id=eos))
+        out = dec.wait(entry, timeout=300)
+        assert out["lengths"] == [1] and out["tokens"][0][0] == eos
+    finally:
+        dec.close()
+
+
+def test_mixed_knobs_share_one_slab(served):
+    """Greedy, temperature, and top-k rows decode concurrently in one slab —
+    per-row knobs are runtime data, not per-program constants."""
+    m, variables = served
+    p = np.arange(1, 7, dtype=np.int32)[None]
+    ref, _ = one_shot(m, variables, p, 6)
+    dec = BatchingDecoder(m, variables, slots=4, chunk_steps=4)
+    try:
+        greedy = dec.submit(GenerateRequest(prompts=p.tolist(), max_new_tokens=6))
+        hot = dec.submit(GenerateRequest(prompts=p.tolist(), max_new_tokens=6,
+                                         temperature=1.2, seed=11))
+        topk = dec.submit(GenerateRequest(prompts=p.tolist(), max_new_tokens=6,
+                                          temperature=0.9, top_k=5, seed=3))
+        g = dec.wait(greedy, timeout=300)
+        h = dec.wait(hot, timeout=300)
+        t = dec.wait(topk, timeout=300)
+        assert g["tokens"][0] == ref[0].tolist()  # sampling neighbors don't perturb greedy
+        for out in (h, t):
+            arr = np.asarray(out["tokens"][0])
+            assert arr.shape == (6,) and np.all((arr >= 0) & (arr < VOCAB))
+    finally:
+        dec.close()
+
+
+def test_sampling_reproducible_across_decoders(served):
+    m, variables = served
+    p = np.arange(1, 7, dtype=np.int32)[None]
+    req = dict(prompts=p.tolist(), max_new_tokens=6, temperature=0.8, seed=42)
+    outs = []
+    for _ in range(2):
+        dec = BatchingDecoder(m, variables, slots=2, chunk_steps=4)
+        try:
+            outs.append(dec.wait(dec.submit(GenerateRequest(**req)), timeout=300))
+        finally:
+            dec.close()
+    assert outs[0]["tokens"] == outs[1]["tokens"]
+
+
+def test_stream_chunks_concatenate_to_result(served):
+    m, variables = served
+    p = np.arange(1, 9, dtype=np.int32)[None]
+    ref, _ = one_shot(m, variables, p, 10)
+    dec = BatchingDecoder(m, variables, slots=2, chunk_steps=3)
+    try:
+        entry = dec.submit(GenerateRequest(prompts=p.tolist(), max_new_tokens=10,
+                                           stream=True))
+        got, final = [], None
+        for rec in dec.stream(entry):
+            if rec.get("done"):
+                final = rec
+            else:
+                assert rec["row"] == 0
+                got.extend(rec["tokens"])
+        assert got == ref[0].tolist()
+        assert final["lengths"] == [10]
+        # deltas arrived in more than one chunk (chunk_steps=3 < 10 tokens)
+        assert len(got) == 10
+    finally:
+        dec.close()
+
+
+def test_capacity_and_topk_rejections(served):
+    m, variables = served
+    dec = BatchingDecoder(m, variables, slots=2, chunk_steps=2)
+    try:
+        with pytest.raises(KubeMLError) as e:
+            dec.submit(GenerateRequest(prompts=[[1, 2, 3]], max_new_tokens=63))
+        assert e.value.status_code == 400
+    finally:
+        dec.close()
+
+
+def test_concurrent_submitters_threads(served):
+    """Racing client threads: every request resolves with its own answer."""
+    m, variables = served
+    dec = BatchingDecoder(m, variables, slots=4, chunk_steps=4)
+    try:
+        prompts = [np.arange(1, 4 + i, dtype=np.int32)[None] for i in range(6)]
+        refs = [one_shot(m, variables, p, 5)[0][0].tolist() for p in prompts]
+        results = [None] * 6
+        errors = []
+
+        def run(i):
+            try:
+                entry = dec.submit(GenerateRequest(prompts=prompts[i].tolist(),
+                                                   max_new_tokens=5))
+                results[i] = dec.wait(entry, timeout=600)["tokens"][0]
+            except Exception as e:  # surface in the main thread
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        assert not errors
+        assert results == refs
+    finally:
+        dec.close()
+
+
+def test_timeout_cancels_and_frees_slots(served):
+    """A waiter that times out must not leave its rows burning decode slots:
+    the slot frees and later traffic is served promptly."""
+    m, variables = served
+    dec = BatchingDecoder(m, variables, slots=1, chunk_steps=2)
+    try:
+        p = np.arange(1, 5, dtype=np.int32)[None]
+        big = dec.submit(GenerateRequest(prompts=p.tolist(), max_new_tokens=48))
+        with pytest.raises(KubeMLError) as e:
+            dec.wait(big, timeout=0.0)  # immediate timeout -> cancel
+        assert e.value.status_code == 504
+        # the single slot must come back: a fresh request completes
+        ref, _ = one_shot(m, variables, p, 4)
+        out = dec.wait(dec.submit(GenerateRequest(prompts=p.tolist(),
+                                                  max_new_tokens=4)), timeout=300)
+        assert out["tokens"][0] == ref[0].tolist()
+    finally:
+        dec.close()
+
+
+def test_retire_finishes_inflight_rejects_new(served):
+    m, variables = served
+    dec = BatchingDecoder(m, variables, slots=2, chunk_steps=2)
+    p = np.arange(1, 6, dtype=np.int32)[None]
+    ref, _ = one_shot(m, variables, p, 8)
+    entry = dec.submit(GenerateRequest(prompts=p.tolist(), max_new_tokens=8))
+    dec.retire()
+    with pytest.raises(KubeMLError):
+        dec.submit(GenerateRequest(prompts=p.tolist(), max_new_tokens=2))
+    out = dec.wait(entry, timeout=300)  # in-flight work still completes
+    assert out["tokens"][0] == ref[0].tolist()
+
+
+def test_closed_decoder_rejects(served):
+    m, variables = served
+    dec = BatchingDecoder(m, variables, slots=2)
+    dec.close()
+    with pytest.raises(KubeMLError):
+        dec.submit(GenerateRequest(prompts=[[1, 2]], max_new_tokens=2))
+
+
+# --- wire-type validation added with the batcher (ADVICE round 3) ---
+
+def test_generate_request_rejects_bool_knobs():
+    with pytest.raises(ValueError, match="top_k"):
+        GenerateRequest(prompts=[[1]], top_k=True)
+    with pytest.raises(ValueError, match="seed"):
+        GenerateRequest(prompts=[[1]], seed=False)
+    with pytest.raises(ValueError, match="temperature"):
+        GenerateRequest(prompts=[[1]], temperature=True)
+
+
+def test_generate_request_caps():
+    from kubeml_tpu.api import types as T
+
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        GenerateRequest(prompts=[[1]], max_new_tokens=T.GENERATE_MAX_NEW_TOKENS_CAP + 1)
+    with pytest.raises(ValueError, match="top_k"):
+        GenerateRequest(prompts=[[1]], top_k=T.GENERATE_MAX_TOP_K + 1)
+    with pytest.raises(ValueError, match="batch"):
+        GenerateRequest(prompts=[[1]] * (T.GENERATE_MAX_BATCH + 1))
+    with pytest.raises(ValueError, match="prompt length"):
+        GenerateRequest(prompts=[[1] * (T.GENERATE_MAX_PROMPT_LEN + 1)])
+
+
+def test_generate_request_prompt_lengths_validation():
+    GenerateRequest(prompts=[[1, 2, 3], [1, 2, 3]], prompt_lengths=[3, 2])
+    with pytest.raises(ValueError, match="prompt_lengths"):
+        GenerateRequest(prompts=[[1, 2]], prompt_lengths=[1, 2])
+    with pytest.raises(ValueError, match="prompt_lengths"):
+        GenerateRequest(prompts=[[1, 2]], prompt_lengths=[3])
+    with pytest.raises(ValueError, match="prompt_lengths"):
+        GenerateRequest(prompts=[[1, 2]], prompt_lengths=[True])

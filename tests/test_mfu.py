@@ -12,9 +12,9 @@ def test_roofline_mfu_math(monkeypatch):
     monkeypatch.setenv("KUBEML_PEAK_FLOPS", "0.1")
     monkeypatch.setenv("KUBEML_HBM_BW", "10")
     # intensity 5 flops/byte -> 5 * 10e9 = 50 GFLOP/s achievable -> 0.5 ceiling
-    assert roofline_mfu(flops=5e9, bytes_accessed=1e9) == pytest.approx(0.5)
+    assert roofline_mfu(flops=5e9, hbm_bytes=1e9) == pytest.approx(0.5)
     # intensity high enough to hit the compute peak -> ceiling 1.0
-    assert roofline_mfu(flops=1e12, bytes_accessed=1e9) == pytest.approx(1.0)
+    assert roofline_mfu(flops=1e12, hbm_bytes=1e9) == pytest.approx(1.0)
     assert roofline_mfu(None, 1e9) is None
     assert roofline_mfu(1e9, None) is None
 
@@ -45,8 +45,41 @@ def test_round_costs_reports_flops_and_bytes():
     costs = trainer.round_costs(variables, x, y, mask, lr=0.1)
     assert costs["flops"] and costs["flops"] > 0
     assert costs["bytes_accessed"] and costs["bytes_accessed"] > 0
+    # post-fusion traffic parses; it tracks the pre-fusion count to within
+    # an order of magnitude (on CPU the two accountings differ a few percent
+    # either way: my model re-counts duplicate operand reads, XLA's counts
+    # pre-fusion materializations — the big divergence is on fused TPU
+    # programs, chip-validated in the bench)
+    assert costs["bytes_hbm"] and costs["bytes_hbm"] > 0
+    assert 0.1 < costs["bytes_hbm"] / costs["bytes_accessed"] < 10.0
     # k scaling: the k-step round must cost k x the 1-step program
     k1 = trainer.round_costs(variables, x[:, :1], y[:, :1], mask[:, :1], lr=0.1)
     assert costs["flops"] == pytest.approx(k1["flops"] * k)
     # round_flops stays the flops view of the same analysis
     assert trainer.round_flops(variables, x, y, mask, lr=0.1) == costs["flops"]
+
+
+def test_post_fusion_bytes_counts_fused_program():
+    """The post-fusion parser: fusion bodies are opaque (their intermediates
+    never hit HBM), while-loop bodies are traversed, plumbing ops are free."""
+    import jax.numpy as jnp
+
+    from kubeml_tpu.benchmarks.mfu import post_fusion_bytes
+
+    @jax.jit
+    def f(x, w):
+        # elementwise chain fuses into the matmuls: the tanh/relu
+        # intermediates must NOT be counted as HBM traffic on TPU-like
+        # backends; on CPU the parse still returns a positive total
+        h = jnp.tanh(x @ w)
+        h = jax.nn.relu(h + 1.0)
+        return (h @ w).sum()
+
+    x = np.zeros((64, 128), np.float32)
+    w = np.zeros((128, 128), np.float32)
+    text = f.lower(x, w).compile().as_text()
+    got = post_fusion_bytes(text)
+    assert got and got > 0
+    # sanity bound: traffic can't be less than reading both inputs once and
+    # writing the scalar out
+    assert got >= x.nbytes + w.nbytes
