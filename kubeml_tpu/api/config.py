@@ -74,6 +74,19 @@ class Config:
         default_factory=lambda: _env_bool("KUBEML_TENSOR_SOCKETS", True)
     )
 
+    # --- function execution guardrails (reference cmd/function.go:234-262:
+    # per-function concurrency 50, execution timeout 1000s) ---
+    # seconds a user-code call (function load, traced user module, a job
+    # round with no progress) may run before being abandoned/failed; <= 0
+    # disables
+    function_timeout: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_FUNCTION_TIMEOUT", "1000"))
+    )
+    # simultaneous in-process user-function loads/invocations
+    function_concurrency: int = field(
+        default_factory=lambda: _env_int("KUBEML_FUNCTION_CONCURRENCY", 50)
+    )
+
     # --- /generate serving (kubeml_tpu.serving.BatchingDecoder) ---
     # continuous batching coalesces concurrent decode requests into one
     # slot-based batched loop (decode is HBM-bound: batch is ~free throughput)
@@ -82,9 +95,9 @@ class Config:
     )
     # resident decode slots (KV-cache HBM scales linearly with this)
     serving_slots: int = field(default_factory=lambda: _env_int("KUBEML_SERVING_SLOTS", 8))
-    # decode steps per host round-trip: larger amortizes dispatch, smaller
-    # tightens admission latency for newly arriving requests
-    serving_chunk_steps: int = field(default_factory=lambda: _env_int("KUBEML_SERVING_CHUNK", 8))
+    # decode steps per device program: larger amortizes dispatch overhead,
+    # smaller tightens admission latency for newly arriving requests
+    serving_chunk_steps: int = field(default_factory=lambda: _env_int("KUBEML_SERVING_CHUNK", 16))
 
     def job_socket_path(self, job_id: str):
         """Unix-socket path for a standalone job's tensor server. Lives under

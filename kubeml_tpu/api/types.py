@@ -123,6 +123,12 @@ class TrainOptions(_JsonMixin):
     checkpoint_every: int = 0  # save a checkpoint every N epochs; 0 = off
     checkpoint_keep: int = 0  # retain only the newest N epoch checkpoints; 0 = all
     resume: bool = False  # restore the latest checkpoint for this job id and continue
+    # SPMD engine: write epoch checkpoints as per-process SHARD files +
+    # manifest (storage.sharded_checkpoint) — no host ever gathers the full
+    # pytree, and resume works onto a different mesh shape. The final export
+    # stays one portable file (serving needs it); at multi-billion-param
+    # scale turn save_model off and serve from the sharded checkpoints.
+    sharded_checkpoints: bool = False
     save_model: bool = True  # export the final model at job end (enables later infer)
     # --- fault injection (chaos testing; the reference only mentions chaos-monkey) ---
     chaos_prob: float = 0.0  # per-worker per-round failure probability

@@ -136,6 +136,15 @@ def cmd_dataset(args) -> int:
     if args.action == "create":
         s = c.create(args.name, args.traindata, args.trainlabels, args.testdata, args.testlabels)
         _print(s.to_dict())
+    elif args.action == "create-text":
+        from pathlib import Path
+
+        corpus = Path(args.corpus).read_text()
+        test = Path(args.test_corpus).read_text() if args.test_corpus else None
+        tokenizer = (json.loads(Path(args.tokenizer).read_text())
+                     if args.tokenizer else None)
+        _print(c.create_text(args.name, corpus, corpus_test=test,
+                             seq_len=args.seq_len, tokenizer=tokenizer))
     elif args.action == "delete":
         c.delete(args.name)
         print(f"deleted {args.name}")
@@ -387,6 +396,16 @@ def build_parser() -> argparse.ArgumentParser:
     dc.add_argument("--trainlabels", required=True)
     dc.add_argument("--testdata", required=True)
     dc.add_argument("--testlabels", required=True)
+    dt = dsub.add_parser("create-text",
+                         help="upload a text corpus as a packed LM token dataset")
+    dt.add_argument("--name", "-n", required=True)
+    dt.add_argument("--corpus", required=True,
+                    help="UTF-8 text file; blank lines separate documents")
+    dt.add_argument("--test-corpus", default=None,
+                    help="held-out corpus (default: 90/10 row split)")
+    dt.add_argument("--seq-len", type=int, default=512)
+    dt.add_argument("--tokenizer", default=None,
+                    help="vocab-JSON tokenizer asset (default: byte-level)")
     dd = dsub.add_parser("delete")
     dd.add_argument("--name", "-n", required=True)
     dsub.add_parser("list")

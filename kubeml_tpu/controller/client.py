@@ -117,6 +117,24 @@ class _Datasets:
             )
         )
 
+    def create_text(self, name: str, corpus: str, *, corpus_test=None,
+                    seq_len: int = 512, tokenizer: dict = None) -> dict:
+        """Upload a TEXT corpus: the server tokenizes (byte-level by default,
+        or a vocab-JSON tokenizer asset) and packs [N, seq_len] token rows
+        with EOS separators — the LM engines then train from it like any
+        token dataset. Returns the dataset summary + packing metadata."""
+        import json as _json
+
+        files = {"corpus": ("corpus.txt", corpus.encode("utf-8")),
+                 "seq-len": (None, str(seq_len))}
+        if corpus_test is not None:
+            files["corpus-test"] = ("corpus-test.txt", corpus_test.encode("utf-8"))
+        if tokenizer is not None:
+            files["tokenizer"] = ("tokenizer.json", _json.dumps(tokenizer).encode())
+        return _check(
+            requests.post(f"{self.c.url}/dataset/{name}", files=files,
+                          timeout=max(self.c.timeout, 300)))
+
     def get(self, name: str) -> DatasetSummary:
         return DatasetSummary.from_dict(
             _check(requests.get(f"{self.c.url}/dataset/{name}", timeout=self.c.timeout))

@@ -22,7 +22,7 @@ from ..api.types import GenerateRequest, InferRequest, TrainRequest
 from ..functions.registry import FunctionRegistry
 from ..storage.checkpoint import CheckpointStore
 from ..storage.history import HistoryStore
-from ..storage.service import REQUIRED_FILES, decode_array, parse_multipart
+from ..storage.service import parse_multipart
 from ..storage.store import ShardStore
 from ..utils.httpd import Request, Response, Router, Service, StreamResponse
 
@@ -103,18 +103,10 @@ class Controller:
         return self.store.get(req.params["name"]).summary().to_dict()
 
     def _dataset_create(self, req: Request):
+        from ..storage.service import create_dataset_from_upload
+
         files = parse_multipart(req.body, req.headers.get("Content-Type", ""))
-        missing = [f for f in REQUIRED_FILES if f not in files]
-        if missing:
-            raise KubeMLError(f"missing upload files: {missing}", 400)
-        arrays = {f: decode_array(files[f], f) for f in REQUIRED_FILES}
-        return self.store.create(
-            req.params["name"],
-            x_train=arrays["x-train"],
-            y_train=arrays["y-train"],
-            x_test=arrays["x-test"],
-            y_test=arrays["y-test"],
-        ).to_dict()
+        return create_dataset_from_upload(self.store, req.params["name"], files)
 
     def _dataset_delete(self, req: Request):
         self.store.delete(req.params["name"])

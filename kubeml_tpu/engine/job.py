@@ -126,6 +126,10 @@ class TrainJob:
         self.history = History(id=job_id, task={"request": request.to_dict()})
         self.history.notes.extend(self._pending_notes)
         self.stop_event = threading.Event()
+        # progress stamp for the PS heartbeat monitor (function guardrails):
+        # a job whose user code hangs inside a traced program goes stale here
+        # and is failed by the monitor instead of wedging its thread forever
+        self.heartbeat = time.time()
         self.exit_error: Optional[str] = None
         self._stacked_vars = None
         self._final_variables = None
@@ -429,6 +433,7 @@ class TrainJob:
                 loss = self._run_round(rb, rng, worker_mask, epoch, staged=rb_staged)
             if loss is None:  # stop requested during retry backoff
                 break
+            self.heartbeat = time.time()  # round dispatched: job is alive
             if not losses:
                 # first round dispatched: background-precompile the next
                 # topology-legal scale-up level while this epoch trains, so an

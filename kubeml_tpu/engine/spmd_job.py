@@ -114,6 +114,8 @@ class SPMDJob:
 
         self.history = History(id=job_id, task={"request": request.to_dict()})
         self.stop_event = threading.Event()
+        # progress stamp for the PS heartbeat monitor (function guardrails)
+        self.heartbeat = time.time()
         self.exit_error: Optional[str] = None
         self._dataset_handle = None
         # live inference and a donating train step must not touch the same
@@ -211,6 +213,7 @@ class SPMDJob:
                         step_rng = jax.random.fold_in(rng, epoch * 100003 + i)
                         with self._step_lock:
                             losses.append(self.trainer.train_step(batch, step_rng))
+                        self.heartbeat = time.time()
                 if not losses:
                     break  # stopped mid-epoch
                 train_loss = float(np.mean([float(l) for l in losses]))
@@ -305,6 +308,12 @@ class SPMDJob:
 
         from .resume import extend_history, select_resume_checkpoint
 
+        if self.request.options.sharded_checkpoints:
+            start = self._restore_sharded()
+            if start >= 0:
+                return start
+            # fall through: a job may upgrade to sharded checkpoints while
+            # resuming from an older flat checkpoint
         if self.dist is not None and self.dist.size > 1:
             # leader selects; every process loads the SAME tag from its own
             # (shared-filesystem) store — independent selection could diverge
@@ -405,7 +414,10 @@ class SPMDJob:
         host = self._host_params()
         shape = dict(self._model_axes, dp=dp_new)
         self.mesh = make_mesh(shape=shape, devices=chosen)
-        self.model.mesh = self.mesh
+        # rebuild the module against the new mesh: a stale capture (sp
+        # shard_map, pipeline sharding constraints) would issue collectives
+        # sized for the old device set
+        self.model.rebind_mesh(self.mesh)
         with self._step_lock:
             self.trainer = self._make_trainer(self.mesh)
             self.trainer.init(rng, sample_batch)  # shardings + fresh opt state
@@ -442,6 +454,9 @@ class SPMDJob:
         }
 
     def _save_checkpoint(self, epoch: int) -> None:
+        if self.request.options.sharded_checkpoints:
+            self._save_checkpoint_sharded(epoch)
+            return
         # the gather is COLLECTIVE in dist mode and must stay OUTSIDE the
         # non-fatal guard: swallowing a one-sided fault here would let this
         # process run ahead while its peers sit in the gather — the hang the
@@ -462,6 +477,55 @@ class SPMDJob:
                 )
             except Exception:
                 log.exception("%s: checkpoint save failed (non-fatal)", self.job_id)
+
+    def _sharded_store(self):
+        from ..storage.sharded_checkpoint import ShardedCheckpointStore
+
+        return ShardedCheckpointStore(root=self.checkpoint_store.root)
+
+    def _save_checkpoint_sharded(self, epoch: int) -> None:
+        """Gather-free checkpoint: every process writes only the leaf slices
+        its devices own (storage.sharded_checkpoint). COLLECTIVE in dist mode
+        (the pre-manifest barrier); faults are fatal for the same one-sided
+        reasons as the gather above."""
+        import flax.linen as nn
+
+        with self.tracer.span("job.checkpoint", job=self.job_id, epoch=epoch,
+                              sharded=True):
+            barrier = (self.dist.barrier
+                       if self.dist is not None and self.dist.size > 1 else None)
+            self._sharded_store().save(
+                self.job_id, nn.meta.unbox(self.trainer.params),
+                epoch=epoch, tag=f"ep{epoch:05d}",
+                meta={"request": self.request.to_dict(),
+                      "history": self._history_lists()},
+                barrier=(lambda tag: barrier(f"{tag}/{epoch}"))
+                if barrier is not None else None,
+            )
+
+    def _restore_sharded(self) -> int:
+        """Resume from the newest SHARDED checkpoint onto the CURRENT mesh
+        (which may have a different dp level than the writer's): each process
+        reads only the slices its own devices need. Returns the start epoch,
+        or -1 when no sharded checkpoint exists."""
+        import flax.core.meta as meta
+
+        from .resume import extend_history
+
+        store = self._sharded_store()
+        tags = store.tags(self.job_id)
+        if not tags:
+            return -1
+        tag = tags[-1]
+        unboxed = meta.unbox(self.trainer.params)
+        shardings = jax.tree.map(lambda x: x.sharding, unboxed)
+        ck = store.restore(self.job_id, tag, shardings=shardings)
+        self.trainer.params = meta.replace_boxed(self.trainer.params, ck.variables)
+        extend_history(self.history, ck)
+        start = int(ck.epoch) + 1
+        log.info("%s: resumed from sharded checkpoint %s (epoch %d)",
+                 self.job_id, tag, start)
+        return start
 
     def _push_metrics(self, train_loss, val_loss, acc_pct, elapsed, parallelism) -> None:
         if self.on_metrics is None:

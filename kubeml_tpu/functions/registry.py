@@ -48,9 +48,14 @@ class FunctionRegistry:
     """Filesystem registry: ``<functions_dir>/<name>.py``."""
 
     def __init__(self, root: Optional[Path] = None, config: Optional[Config] = None):
-        cfg = config or get_config()
-        self.root = Path(root) if root is not None else cfg.functions_dir
+        import threading
+
+        self.cfg = config or get_config()
+        self.root = Path(root) if root is not None else self.cfg.functions_dir
         self.root.mkdir(parents=True, exist_ok=True)
+        # reference parity: per-function concurrency cap (function.go:234-262)
+        self._load_slots = threading.Semaphore(
+            max(1, self.cfg.function_concurrency))
 
     def _path(self, name: str) -> Path:
         if not name or "/" in name or name.startswith("."):
@@ -110,12 +115,30 @@ class FunctionRegistry:
 
         A unique module name per load keeps concurrent jobs isolated from each
         other's module state (the reference gets isolation from per-pod
-        specialization)."""
-        from ..runtime.model import KubeModel
+        specialization).
+
+        Guardrails (reference function.go:234-262 — concurrency 50, timeout
+        1000s): loads share a concurrency semaphore, and the user import +
+        constructor run under the function timeout — a user module that hangs
+        at import is abandoned on its watchdog thread with a 408, never
+        wedging the caller (PS start, controller validation)."""
+        from ..utils.watchdog import FunctionBusyError, run_with_timeout
 
         path = self._path(name)
         if not path.exists():
             raise FunctionNotFoundError(name)
+        if not self._load_slots.acquire(timeout=1.0):
+            raise FunctionBusyError(self.cfg.function_concurrency)
+        try:
+            return run_with_timeout(
+                lambda: self._load_unguarded(name, path),
+                self.cfg.function_timeout, f"loading function {name!r}")
+        finally:
+            self._load_slots.release()
+
+    def _load_unguarded(self, name: str, path):
+        from ..runtime.model import KubeModel
+
         mod_name = f"kubeml_fn_{name}_{uuid.uuid4().hex[:8]}"
         spec = importlib.util.spec_from_file_location(mod_name, path)
         module = importlib.util.module_from_spec(spec)

@@ -1,0 +1,221 @@
+"""Pipeline-parallel causal LM — the ``pp`` mesh axis, reachable from the
+SPMD engine.
+
+No reference counterpart (SURVEY §2.4: pipeline parallelism — absent; round-3
+VERDICT missing-#1: the GPipe library existed but no engine path used it).
+This module makes pipelining a MODEL property the existing ``SPMDTrainer``
+consumes unchanged: ``kubeml train --engine spmd --mesh pp=2,tp=2`` just
+needs the function file to build :class:`PipelinedCausalLM`.
+
+Design — vmap-over-stages SPMD pipelining (no shard_map):
+
+* The block stack is split into ``pp`` stages of ``depth/pp`` layers. Stage
+  parameters are STACKED on a leading axis via ``nn.vmap`` whose
+  ``metadata_params`` names that axis ``pp`` — so ``nn.get_partition_spec``
+  yields ``('pp', ..., 'tp')`` specs and the stock trainer shards stages
+  across the pp device groups while keeping megatron tp inside each stage.
+* Each schedule tick applies ALL stages at once through the vmapped stage on
+  a ``[S, mb, L, E]`` rolling buffer (each stage holds its current
+  microbatch), then shifts the buffer one stage down (``jnp.roll``). With
+  the buffer sharded ``P('pp', 'dp')``, XLA's SPMD partitioner compiles each
+  stage's compute onto its own pp group and the shift into a
+  collective-permute over ICI — the pipeline emerges from shardings alone,
+  the scaling-book way, and the whole schedule is one differentiable
+  ``nn.scan`` (backprop replays the ring in reverse automatically).
+* Microbatches stream through GPipe-style: bubble fraction (S-1)/(M+S-1).
+  Activation memory is bounded by ``remat`` on the stage body (the reason
+  1F1B exists in hand-scheduled frameworks); a manual 1F1B interleave would
+  fight XLA's scheduler for no bubble win — raising ``microbatches`` is the
+  bubble lever here.
+
+Composes: pp x tp x dp (batch axis sharded over dp inside each microbatch).
+Sequence parallelism stays with the flat ``CausalTransformer`` — sp's ring
+attention and pp's ring both want the ICI loop, so the axes are alternatives
+in this zoo, not a product.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .gpt import GPTBlock, PAD_ID, _part
+
+
+class _Stage(nn.Module):
+    """``depth/pp`` dense blocks — one pipeline stage (mesh-free: tp comes
+    from param annotations, sp never enters the pipelined model)."""
+
+    n_layers: int
+    num_heads: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+    ln_eps: float = 1e-6
+    attn_bias: bool = False
+    rope: bool = False
+    rope_theta: float = 10000.0
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x, valid, train: bool = False):
+        for i in range(self.n_layers):
+            cls = (nn.remat(GPTBlock, static_argnums=(3, 4)) if self.remat
+                   else GPTBlock)
+            x = cls(self.num_heads, self.mlp_ratio, self.dropout, mesh=None,
+                    dtype=self.dtype, ln_eps=self.ln_eps,
+                    attn_bias=self.attn_bias, rope=self.rope,
+                    rope_theta=self.rope_theta,
+                    name=f"layer_{i}")(x, valid, train, False)
+        return x
+
+
+class PipelinedCausalLM(nn.Module):
+    """Decoder-only LM over int32 ids [B, L]; id 0 = padding. Same tail
+    (ln_f / lm_head / ``return_hidden``) as ``CausalTransformer`` so the SPMD
+    trainer's loss paths (incl. chunked LM loss) apply unchanged.
+
+    ``batch`` must divide into ``microbatches``; ``depth`` into ``stages``.
+    Decode/generation is served by the flat model from the same checkpoint
+    family — the pipeline exists for training depth, not serving.
+    """
+
+    vocab_size: int = 32000
+    max_len: int = 2048
+    embed_dim: int = 512
+    depth: int = 8
+    num_heads: int = 8
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    stages: int = 2
+    microbatches: int = 4
+    mesh: Optional[Mesh] = None
+    dtype: Any = jnp.float32
+    remat: bool = False
+    ln_eps: float = 1e-6
+    attn_bias: bool = False
+    pos: str = "learned"  # "learned" | "rope"
+    rope_theta: float = 10000.0
+
+    @nn.compact
+    def __call__(self, token_ids, train: bool = False,
+                 return_hidden: bool = False):
+        token_ids = token_ids.astype(jnp.int32)
+        B, L = token_ids.shape
+        S, M = self.stages, self.microbatches
+        if self.depth % S != 0:
+            raise ValueError(f"depth {self.depth} must divide into {S} stages")
+        if B % M != 0:
+            raise ValueError(f"batch {B} must divide into {M} microbatches")
+        if self.pos not in ("learned", "rope"):
+            raise ValueError(f"unknown pos {self.pos!r} (valid: 'learned', 'rope')")
+        use_rope = self.pos == "rope"
+        valid = token_ids != PAD_ID
+
+        x = nn.Embed(self.vocab_size, self.embed_dim, name="token_embed",
+                     embedding_init=_part((None, "tp"))(
+                         nn.initializers.normal(0.02)))(token_ids)
+        if not use_rope:
+            pos = self.param("pos_embed",
+                             _part((None, None, "tp"))(nn.initializers.normal(0.02)),
+                             (1, self.max_len, self.embed_dim))
+            x = x + pos[:, :L]
+        x = x.astype(self.dtype)
+
+        mb = B // M
+        x_mb = x.reshape(M, mb, L, self.embed_dim)
+        valid_mb = valid.reshape(M, mb, L)
+
+        VStage = nn.vmap(
+            _Stage,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            in_axes=(0, 0, None),
+            out_axes=0,
+            metadata_params={nn.meta.PARTITION_NAME: "pp"},
+        )
+        stage = VStage(self.depth // S, self.num_heads, self.mlp_ratio,
+                       self.dropout, self.dtype, self.ln_eps, self.attn_bias,
+                       use_rope, self.rope_theta, self.remat, name="stages")
+
+        mesh = self.mesh
+        buf_sharding = (NamedSharding(mesh, P("pp", "dp"))
+                        if mesh is not None else None)
+
+        def constrain(t):
+            return (jax.lax.with_sharding_constraint(t, buf_sharding)
+                    if buf_sharding is not None else t)
+
+        T = M + S - 1
+
+        def tick(mdl, carry, t):
+            buf, vbuf, outs = carry
+            # stage 0 injects microbatch t during fill; drain ticks recycle
+            # whatever rolled around (never collected — see the exit gate)
+            mc_in = jnp.clip(t, 0, M - 1)
+            inj = jax.lax.dynamic_index_in_dim(x_mb, mc_in, 0, keepdims=False)
+            vinj = jax.lax.dynamic_index_in_dim(valid_mb, mc_in, 0, keepdims=False)
+            take = t < M
+            buf = buf.at[0].set(jnp.where(take, inj, buf[0]))
+            vbuf = vbuf.at[0].set(jnp.where(take, vinj, vbuf[0]))
+            buf = constrain(buf)
+            y = mdl(buf, vbuf, train)  # every stage computes its microbatch
+            y = constrain(y)
+            # the last stage completes microbatch m = t - (S-1) at tick t
+            m = t - (S - 1)
+            mc = jnp.clip(m, 0, M - 1)
+            prev = jax.lax.dynamic_index_in_dim(outs, mc, 0, keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(m >= 0, y[S - 1], prev), mc, 0)
+            # shift stage->stage+1 (XLA: collective-permute over pp)
+            return (jnp.roll(y, 1, axis=0), jnp.roll(vbuf, 1, axis=0), outs), None
+
+        buf0 = constrain(jnp.zeros((S, mb, L, self.embed_dim), x_mb.dtype))
+        vbuf0 = jnp.zeros((S, mb, L), bool)
+        outs0 = jnp.zeros_like(x_mb)
+        scan = nn.scan(tick, variable_broadcast="params",
+                       split_rngs={"params": False, "dropout": True}, length=T)
+        (_, _, outs), _ = scan(stage, (buf0, vbuf0, outs0), jnp.arange(T))
+
+        x = outs.reshape(B, L, self.embed_dim)
+        x = nn.LayerNorm(name="ln_f", dtype=jnp.float32,
+                         epsilon=self.ln_eps)(x).astype(self.dtype)
+        if return_hidden:
+            return x
+        logits = nn.Dense(self.vocab_size, name="lm_head", use_bias=False,
+                          dtype=self.dtype,
+                          kernel_init=_part((None, "tp"))(
+                              nn.initializers.lecun_normal()))(x)
+        return logits.astype(jnp.float32)
+
+    def sequential_apply(self, variables, token_ids, train: bool = False):
+        """Non-pipelined forward with the SAME (stacked) params — the parity
+        oracle for the schedule (tests drive both and compare logits)."""
+        token_ids = jnp.asarray(token_ids, jnp.int32)
+        B, L = token_ids.shape
+        valid = token_ids != PAD_ID
+        params = nn.meta.unbox(variables["params"])
+        x = params["token_embed"]["embedding"][token_ids]
+        if self.pos == "learned":
+            x = x + params["pos_embed"][:, :L]
+        x = x.astype(self.dtype)
+        stage = _Stage(self.depth // self.stages, self.num_heads,
+                       self.mlp_ratio, self.dropout, self.dtype, self.ln_eps,
+                       self.attn_bias, self.pos == "rope", self.rope_theta,
+                       parent=None)  # detached oracle module, not a child
+        stacked = params["stages"]
+        for s in range(self.stages):
+            p_s = jax.tree.map(lambda a: a[s], stacked)
+            x = stage.apply({"params": p_s}, x, valid, train)
+        ln = params["ln_f"]
+        mu = x.astype(jnp.float32)
+        mean = mu.mean(-1, keepdims=True)
+        var = ((mu - mean) ** 2).mean(-1, keepdims=True)
+        x = ((mu - mean) / jnp.sqrt(var + self.ln_eps) * ln["scale"]
+             + ln["bias"]).astype(self.dtype)
+        logits = x @ params["lm_head"]["kernel"].astype(self.dtype)
+        return logits.astype(jnp.float32)

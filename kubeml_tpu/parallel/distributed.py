@@ -315,6 +315,24 @@ class DistContext:
     def put(self, key: str, value: str) -> None:
         self._client.key_value_set(key, value)
 
+    def barrier(self, tag: str, timeout_s: float = 600.0) -> None:
+        """Host-channel barrier: returns once every process has announced
+        ``tag``. Keys carry a per-call sequence number (aligned across
+        processes by the same same-order-calls discipline the broadcast
+        stream relies on), so a REUSED tag — e.g. a resumed job rewriting the
+        same epoch checkpoint — can't satisfy a later barrier with a stale
+        announcement. Raises on timeout — a barrier that silently gives up
+        would let the leader publish a manifest over missing shards."""
+        if self.size == 1:
+            return
+        with self._lock:
+            seq = self._barrier_seq = getattr(self, "_barrier_seq", -1) + 1
+        self.put(f"kubeml/barrier/{seq}/{tag}/{self.rank}", "1")
+        for r in range(self.size):
+            if self.get(f"kubeml/barrier/{seq}/{tag}/{r}", timeout_s) is None:
+                raise TimeoutError(
+                    f"barrier {tag!r}: rank {r} missing after {timeout_s}s")
+
     def get(self, key: str, timeout_s: float = 120.0) -> Optional[str]:
         """Blocking KV read with a real deadline; None on timeout."""
         import time as _time

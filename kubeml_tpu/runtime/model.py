@@ -81,6 +81,15 @@ class KubeModel(ABC):
             self._module = self.build()
         return self._module
 
+    def rebind_mesh(self, mesh) -> None:
+        """Point the model at a new mesh and drop the cached module so the
+        next ``module`` access re-runs ``build()`` against it. The SPMD
+        engine calls this on elastic re-mesh — a module that captured the old
+        mesh (sp shard_map closures, pipeline sharding constraints) would
+        otherwise issue collectives sized for devices it no longer has."""
+        self.mesh = mesh
+        self._module = None
+
     def _set_params(self, *, lr: float, batch_size: int, epoch: int, k: int, task: str) -> None:
         self.lr = lr
         self.batch_size = batch_size

@@ -45,6 +45,71 @@ def parse_multipart(body: bytes, content_type: str) -> Dict[str, bytes]:
     return out
 
 
+def create_dataset_from_upload(store, name: str, files: Dict[str, bytes]) -> dict:
+    """Create a dataset from a parsed multipart upload — shared by the
+    storage service and the controller gateway.
+
+    Two upload forms:
+
+    * the reference's four-array contract (``x-train``/``y-train``/
+      ``x-test``/``y-test`` npy parts — python/storage/api.py:105-142);
+    * a TEXT corpus (``corpus`` part, optional ``corpus-test``,
+      ``seq-len``, ``tokenizer`` JSON asset): tokenized and packed to
+      [N, L] token rows with EOS separators (kubeml_tpu.data.text), stored
+      through the same shard layout so the LM engines train from it
+      unchanged. Without ``corpus-test`` the packed rows split 90/10."""
+    if "corpus" in files:
+        import json as _json
+
+        from ..data.text import pack_corpus
+
+        try:
+            seq_len = int((files.get("seq-len") or b"512").decode().strip() or 512)
+        except ValueError:
+            raise KubeMLError("seq-len must be an integer", 400)
+        spec = None
+        if "tokenizer" in files:
+            try:
+                spec = _json.loads(files["tokenizer"])
+            except ValueError as e:
+                raise KubeMLError(f"tokenizer asset is not valid JSON: {e}", 400)
+        try:
+            corpus_text = files["corpus"].decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise KubeMLError(f"corpus is not valid UTF-8: {e}", 400)
+        rows, meta = pack_corpus(corpus_text, seq_len, spec)
+        if "corpus-test" in files:
+            try:
+                test_text = files["corpus-test"].decode("utf-8")
+            except UnicodeDecodeError as e:
+                raise KubeMLError(f"corpus-test is not valid UTF-8: {e}", 400)
+            test_rows, _ = pack_corpus(test_text, seq_len, spec)
+        else:
+            if len(rows) < 2:
+                raise KubeMLError(
+                    "corpus packs to a single row — supply more text or an "
+                    "explicit corpus-test part", 400)
+            n_test = max(1, len(rows) // 10)
+            test_rows, rows = rows[-n_test:], rows[:-n_test]
+        summary = store.create(
+            name,
+            x_train=rows, y_train=np.zeros(len(rows), np.int64),
+            x_test=test_rows, y_test=np.zeros(len(test_rows), np.int64),
+        )
+        return {**summary.to_dict(), "packing": meta}
+    missing = [f for f in REQUIRED_FILES if f not in files]
+    if missing:
+        raise KubeMLError(f"missing upload files: {missing}", 400)
+    arrays = {f: decode_array(files[f], f) for f in REQUIRED_FILES}
+    return store.create(
+        name,
+        x_train=arrays["x-train"],
+        y_train=arrays["y-train"],
+        x_test=arrays["x-test"],
+        y_test=arrays["y-test"],
+    ).to_dict()
+
+
 def decode_array(payload: bytes, field: str) -> np.ndarray:
     """Decode one uploaded file: .npy bytes or a pickled array/list
     (reference storage accepts both, api.py:30-44 _load_dataset).
@@ -91,18 +156,7 @@ class StorageService:
     def _create(self, req: Request):
         name = req.params["name"]
         files = parse_multipart(req.body, req.headers.get("Content-Type", ""))
-        missing = [f for f in REQUIRED_FILES if f not in files]
-        if missing:
-            raise KubeMLError(f"missing upload files: {missing}", 400)
-        arrays = {f: decode_array(files[f], f) for f in REQUIRED_FILES}
-        summary = self.store.create(
-            name,
-            x_train=arrays["x-train"],
-            y_train=arrays["y-train"],
-            x_test=arrays["x-test"],
-            y_test=arrays["y-test"],
-        )
-        return summary.to_dict()
+        return create_dataset_from_upload(self.store, name, files)
 
     def _delete(self, req: Request):
         self.store.delete(req.params["name"])

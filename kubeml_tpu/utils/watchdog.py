@@ -1,0 +1,67 @@
+"""User-code execution guardrails.
+
+The reference caps every Fission function at concurrency 50 and a 1000s
+execution timeout (/root/reference/ml/pkg/kubeml-cli/cmd/function.go:234-262)
+— Fission enforces both by killing pods. Here user functions run IN-PROCESS
+(registry import, flax-module trace inside the engines), so the equivalents
+are:
+
+* :func:`run_with_timeout` — run a user-code call on a watchdog thread; on
+  timeout the call is ABANDONED (Python cannot kill a thread — the daemon
+  thread leaks until the interpreter exits, the documented cost of in-process
+  functions) and a 408-class :class:`FunctionTimeoutError` is raised so the
+  platform completes degraded instead of wedging.
+* a concurrency semaphore on function loads (functions/registry.py) mirroring
+  the reference's per-function concurrency cap.
+* the PS heartbeat monitor (ps/parameter_server.py) — engines stamp a
+  heartbeat every round/step; a threaded job whose user code hangs INSIDE a
+  traced program (where no wrapper can sit) is detected by staleness, marked
+  FAILED, its slot freed, the scheduler notified.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+from ..api.errors import KubeMLError
+
+
+class FunctionTimeoutError(KubeMLError):
+    def __init__(self, what: str, timeout: float):
+        super().__init__(
+            f"{what} exceeded the function execution timeout ({timeout:g}s; "
+            f"KUBEML_FUNCTION_TIMEOUT)", 408)
+
+
+class FunctionBusyError(KubeMLError):
+    def __init__(self, limit: int):
+        super().__init__(
+            f"function concurrency limit reached ({limit}; "
+            f"KUBEML_FUNCTION_CONCURRENCY)", 429)
+
+
+def run_with_timeout(fn: Callable[[], Any], timeout: float, what: str) -> Any:
+    """Execute ``fn()`` on a watchdog thread; raise FunctionTimeoutError if
+    it doesn't finish in ``timeout`` seconds (the runaway call is abandoned
+    on its daemon thread). ``timeout <= 0`` disables the guard."""
+    if timeout is None or timeout <= 0:
+        return fn()
+    box: dict = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # surfaced on the caller thread
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, name=f"fn-watchdog:{what}", daemon=True)
+    t.start()
+    if not done.wait(timeout):
+        raise FunctionTimeoutError(what, timeout)
+    if "error" in box:
+        raise box["error"]
+    return box["value"]

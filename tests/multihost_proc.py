@@ -75,6 +75,85 @@ def _run_spmd_job(cluster, result) -> None:
     )
 
 
+def _run_sharded_ckpt_mode(cluster, result) -> None:
+    """Sharded (gather-free) checkpointing across the process group: an SPMD
+    tp=2 job writes per-process shard files + manifest each epoch, then a
+    SECOND job with the same id resumes from them on a SMALLER dp level.
+    No process ever gathers the full pytree (VERDICT r3 next-4)."""
+    import numpy as np
+
+    from kubeml_tpu.api.types import JobState, TrainOptions, TrainRequest, TrainTask
+    from kubeml_tpu.storage.sharded_checkpoint import ShardedCheckpointStore
+
+    src = (
+        "import optax\n"
+        "from kubeml_tpu.data.dataset import KubeDataset\n"
+        "from kubeml_tpu.models.gpt import CausalTransformer\n"
+        "from kubeml_tpu.runtime.model import KubeModel\n"
+        "class DS(KubeDataset):\n"
+        "    def __init__(self):\n"
+        "        super().__init__('tokens')\n"
+        "class Model(KubeModel):\n"
+        "    def __init__(self):\n"
+        "        super().__init__(DS())\n"
+        "    def build(self):\n"
+        "        return CausalTransformer(vocab_size=64, max_len=16,\n"
+        "                                 embed_dim=32, depth=2, num_heads=4,\n"
+        "                                 mesh=self.mesh)\n"
+        "    def configure_optimizers(self):\n"
+        "        return optax.adamw(self.lr)\n"
+        "def main():\n"
+        "    return Model()\n"
+    )
+    cluster.registry.create("mhsck", src)
+    r = np.random.default_rng(0)
+    xtr = r.integers(1, 64, size=(256, 16)).astype(np.int32)
+    cluster.store.create("tokens", xtr, np.zeros(256, np.int64),
+                         xtr[:64], np.zeros(64, np.int64))
+
+    def submit(epochs, parallelism, resume):
+        req = TrainRequest(
+            dataset="tokens", function_name="mhsck", epochs=epochs,
+            batch_size=16, lr=1e-3, job_id="mhsck01",
+            options=TrainOptions(engine="spmd", precision="f32",
+                                 mesh_shape={"tp": 2},
+                                 static_parallelism=True,
+                                 default_parallelism=parallelism,
+                                 checkpoint_every=1, sharded_checkpoints=True,
+                                 save_model=False, resume=resume,
+                                 validate_every=0))
+        task = TrainTask(job_id="mhsck01", parameters=req, state=JobState())
+        cluster.ps.start_task(task)
+        cluster.ps.wait(task.job_id, timeout=600)
+        return task, cluster.history_store.get(task.job_id)
+
+    nprocs = int(sys.argv[2])
+    full = jax.device_count()
+    task, hist = submit(epochs=2, parallelism=full, resume=False)
+    sstore = ShardedCheckpointStore(root=cluster.cfg.checkpoints_dir)
+    tags = sstore.tags("mhsck01")
+    manifest = sstore.read_manifest("mhsck01", tags[-1]) if tags else {}
+    d = sstore._dir("mhsck01", tags[-1]) if tags else None
+    shard_files = sorted(p.name for p in d.glob("shard-*.npz")) if d else []
+    first_losses = list(hist.train_loss)
+
+    # resume with HALF the devices (dp halves; tp stays 2); the sharded
+    # restore must re-tile the stored slices onto the smaller mesh
+    task2, hist2 = submit(epochs=4, parallelism=full // 2, resume=True)
+    result.update(
+        status=str(task2.status),
+        epochs=len(hist2.train_loss),
+        train_loss=hist2.train_loss,
+        first_losses=first_losses,
+        parallelism=hist2.parallelism,
+        ckpt_tags=tags,
+        manifest_processes=manifest.get("processes"),
+        shard_files=shard_files,
+        error=(hist2.task.get("error")
+               if isinstance(hist2.task, dict) else None),
+    )
+
+
 def _run_infer_mode(cluster, result) -> None:
     """K-AVG job with per-epoch checkpoints; the leader serves /infer WHILE
     the job trains (from the newest checkpoint snapshot — reference serves
@@ -282,6 +361,9 @@ def main() -> int:
                 raise _Done
             if mode == "chaos":
                 _run_chaos_mode(cluster, result)
+                raise _Done
+            if mode == "sharded_ckpt":
+                _run_sharded_ckpt_mode(cluster, result)
                 raise _Done
             # deploy the function + synthetic dataset (both hosts read the
             # same data root, as a shared filesystem would provide)

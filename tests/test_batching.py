@@ -243,6 +243,10 @@ def test_timeout_cancels_and_frees_slots(served):
     dec = BatchingDecoder(m, variables, slots=1, chunk_steps=2)
     try:
         p = np.arange(1, 5, dtype=np.int32)[None]
+        # warm first: an unwarmed decoder pads client timeouts with the
+        # cold-compile allowance, which would defeat the timeout below
+        dec.wait(dec.submit(GenerateRequest(prompts=p.tolist(),
+                                            max_new_tokens=2)), timeout=300)
         big = dec.submit(GenerateRequest(prompts=p.tolist(), max_new_tokens=48))
         with pytest.raises(KubeMLError) as e:
             dec.wait(big, timeout=0.0)  # immediate timeout -> cancel

@@ -314,6 +314,29 @@ def test_four_process_spmd_job(tmp_path):
         assert r["jobs_followed"] == 1
 
 
+def test_four_process_sharded_checkpoint_resume(tmp_path):
+    """Gather-free checkpointing across a 4-process group (8 global devices,
+    tp=2): every process writes its own shard file, the manifest records the
+    fleet, and a same-id job RESUMES from the sharded checkpoint on HALF the
+    devices (dp 4 -> 2, tp fixed) — the restore re-tiles stored slices onto
+    the smaller mesh with no full-pytree gather anywhere (VERDICT r3 next-4)."""
+    rs = _run_group(tmp_path, "sharded_ckpt", nprocs=4, local_devices=2,
+                    timeout=900)
+    r0 = rs[0]
+    assert "finished" in r0["status"].lower(), r0.get("error")
+    assert r0["manifest_processes"] == 4
+    assert r0["shard_files"] == [f"shard-{i}.npz" for i in range(4)]
+    assert r0["ckpt_tags"]  # epoch checkpoints existed before the resume
+    # resumed run: epochs 0-1 spliced from the checkpoint history, 2-3 trained
+    assert r0["epochs"] == 4
+    assert r0["train_loss"][:2] == r0["first_losses"][:2]
+    assert all(np.isfinite(v) for v in r0["train_loss"])
+    # the resumed job really ran on half the devices
+    assert r0["parallelism"][-1] == 4
+    for r in rs[1:]:
+        assert r["jobs_followed"] == 2
+
+
 def test_four_process_follower_failure_aborts_cleanly(tmp_path):
     rs = _run_group(tmp_path, "split", nprocs=4, local_devices=1,
                     timeout=600)

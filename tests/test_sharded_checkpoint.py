@@ -1,0 +1,184 @@
+"""Sharded (gather-free) checkpointing — storage.sharded_checkpoint.
+
+The contract under test: save writes only addressable slices per process,
+the manifest is the completion marker, and restore reassembles bit-identical
+leaves onto ANY target sharding — including a mesh shape different from the
+writer's (the elastic-resume case the flat store can't serve without a full
+replica-0 gather)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeml_tpu.parallel.mesh import make_mesh
+from kubeml_tpu.storage.sharded_checkpoint import (
+    MANIFEST, ShardedCheckpointStore)
+
+
+def sharded_tree(mesh):
+    """A mixed pytree: tp-sharded matrices, dp-replicated vector, bf16 leaf."""
+    w = jax.device_put(np.arange(64 * 32, dtype=np.float32).reshape(64, 32),
+                       NamedSharding(mesh, P(None, "tp")))
+    b = jax.device_put(np.arange(32, dtype=np.float32),
+                       NamedSharding(mesh, P()))
+    h = jax.device_put(np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+                       .astype(jnp.bfloat16),
+                       NamedSharding(mesh, P("dp", None)))
+    return {"params": {"dense": {"kernel": w, "bias": b}, "h": h}}
+
+
+def test_save_restore_roundtrip_same_mesh(tmp_path):
+    mesh = make_mesh(dp=4, tp=2)
+    tree = sharded_tree(mesh)
+    store = ShardedCheckpointStore(root=tmp_path)
+    d = store.save("job1", tree, epoch=3, tag="ep00003", meta={"note": "x"})
+    assert (d / MANIFEST).exists()
+    # restore as numpy (no target shardings)
+    ck = store.restore("job1", "ep00003")
+    assert ck.epoch == 3 and ck.meta == {"note": "x"}
+    for path in (("params", "dense", "kernel"), ("params", "dense", "bias"),
+                 ("params", "h")):
+        want = tree
+        got = ck.variables
+        for k in path:
+            want, got = want[k], got[k]
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert got.dtype == np.asarray(want).dtype
+
+
+def test_restore_onto_different_mesh(tmp_path):
+    """Write under dp=4 x tp=2; restore under dp=2 x tp=4 — slices re-tile."""
+    mesh_a = make_mesh(dp=4, tp=2)
+    tree = sharded_tree(mesh_a)
+    store = ShardedCheckpointStore(root=tmp_path)
+    store.save("job2", tree, epoch=1, tag="ep00001")
+
+    mesh_b = make_mesh(dp=2, tp=4)
+    shardings = {"params": {"dense": {
+        "kernel": NamedSharding(mesh_b, P(None, "tp")),
+        "bias": NamedSharding(mesh_b, P())},
+        "h": NamedSharding(mesh_b, P("dp", None))}}
+    ck = store.restore("job2", "ep00001", shardings=shardings)
+    k = ck.variables["params"]["dense"]["kernel"]
+    assert isinstance(k, jax.Array)
+    assert k.sharding.spec == P(None, "tp")
+    np.testing.assert_array_equal(
+        np.asarray(k), np.asarray(tree["params"]["dense"]["kernel"]))
+    np.testing.assert_array_equal(
+        np.asarray(ck.variables["params"]["h"]),
+        np.asarray(tree["params"]["h"]))
+
+
+def test_shard_files_hold_slices_not_replicas(tmp_path):
+    """A tp-sharded leaf must be stored as distinct slices (the manifest
+    lists one per shard index), and no slice may be written twice."""
+    mesh = make_mesh(dp=4, tp=2)
+    tree = sharded_tree(mesh)
+    store = ShardedCheckpointStore(root=tmp_path)
+    d = store.save("job3", tree, epoch=0, tag="ep00000")
+    manifest = json.loads((d / MANIFEST).read_text())
+    kernel = manifest["leaves"]["params/dense/kernel"]
+    assert len(kernel["slices"]) == 2  # tp=2 -> two column slices
+    starts = {tuple(s["start"]) for s in kernel["slices"]}
+    assert starts == {(0, 0), (0, 16)}
+    # replicated bias: exactly one stored slice despite 8 device copies
+    bias = manifest["leaves"]["params/dense/bias"]
+    assert len(bias["slices"]) == 1
+    # single-process run: all slices land in shard-0 and the file's keys
+    # are unique (no duplicate writes)
+    z = np.load(d / "shard-0.npz")
+    assert len(set(z.files)) == len(z.files)
+
+
+def test_incomplete_checkpoint_is_invisible(tmp_path):
+    """No manifest -> the tag does not exist (atomic-publish discipline)."""
+    mesh = make_mesh(dp=4, tp=2)
+    tree = sharded_tree(mesh)
+    store = ShardedCheckpointStore(root=tmp_path)
+    d = store.save("job4", tree, epoch=0, tag="ep00000")
+    (d / MANIFEST).unlink()
+    assert store.tags("job4") == []
+    assert not store.exists("job4", "ep00000")
+    with pytest.raises(Exception):
+        store.restore("job4", "ep00000")
+
+
+@pytest.mark.slow
+def test_spmd_job_sharded_checkpoint_resume_different_dp(tmp_path):
+    """The engine path (VERDICT r3 next-4): a tp-sharded SPMD job writes
+    sharded epoch checkpoints (no gather), then a resume with a DIFFERENT dp
+    level restores them onto the new mesh."""
+    from kubeml_tpu.api.config import Config, set_config
+    from kubeml_tpu.api.types import TrainOptions, TrainRequest
+    from kubeml_tpu.engine.spmd_job import SPMDJob
+    from kubeml_tpu.functions.registry import FunctionRegistry
+    from kubeml_tpu.storage import CheckpointStore, HistoryStore, ShardStore
+    from kubeml_tpu.storage.sharded_checkpoint import ShardedCheckpointStore
+
+    cfg = Config(data_root=tmp_path / "kubeml")
+    cfg.ensure_dirs()
+    set_config(cfg)
+    store = ShardStore(config=cfg)
+    r = np.random.default_rng(0)
+    xtr = r.integers(1, 64, size=(64, 16)).astype(np.int32)
+    store.create("stokens", xtr, np.zeros(64, np.int64),
+                 xtr[:32], np.zeros(32, np.int64))
+    reg = FunctionRegistry(config=cfg)
+    reg.create("sckfn", SCK_FN)
+
+    def run(epochs, parallelism, resume):
+        model = reg.load("sckfn")
+        model._set_params(lr=1e-3, batch_size=16, epoch=0, k=1, task="train")
+        req = TrainRequest(
+            model_type="custom", batch_size=16, epochs=epochs,
+            dataset="stokens", lr=1e-3, function_name="sckfn", job_id="sck1",
+            options=TrainOptions(engine="spmd", static_parallelism=True,
+                                 default_parallelism=parallelism,
+                                 mesh_shape={"tp": 2}, checkpoint_every=1,
+                                 sharded_checkpoints=True, resume=resume,
+                                 save_model=False, validate_every=0))
+        job = SPMDJob("sck1", req, model, store=store,
+                      history_store=HistoryStore(config=cfg),
+                      checkpoint_store=CheckpointStore(config=cfg),
+                      devices=jax.devices()[:parallelism])
+        return job.train()
+
+    h1 = run(epochs=2, parallelism=8, resume=False)  # dp=4 x tp=2
+    assert len(h1.train_loss) == 2
+    sstore = ShardedCheckpointStore(root=cfg.checkpoints_dir)
+    assert "ep00001" in sstore.tags("sck1")
+    # no flat epoch checkpoint was written (the gather-free path was used)
+    assert CheckpointStore(config=cfg).epochs("sck1") == []
+
+    h2 = run(epochs=4, parallelism=4, resume=True)   # dp=2 x tp=2 resume
+    # epochs 0 and 1 came from the checkpoint's history; 2 and 3 were trained
+    assert len(h2.train_loss) == 4
+    assert h2.train_loss[:2] == h1.train_loss[:2]
+    assert np.isfinite(h2.train_loss[2:]).all()
+
+
+SCK_FN = """
+import optax
+from kubeml_tpu.runtime.model import KubeModel
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.models.gpt import CausalTransformer
+
+class Tokens(KubeDataset):
+    def __init__(self):
+        super().__init__("stokens")
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(Tokens())
+    def build(self):
+        return CausalTransformer(vocab_size=64, max_len=16, embed_dim=32,
+                                 depth=2, num_heads=4, mesh=self.mesh)
+    def configure_optimizers(self):
+        return optax.adamw(self.lr)
+"""

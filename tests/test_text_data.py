@@ -1,0 +1,154 @@
+"""Text -> token LM data path (kubeml_tpu.data.text + the storage upload
+form): tokenize/pack semantics, wire-level corpus upload, and the VERDICT
+r3 next-6 done-criterion — a text corpus uploaded via the dataset API trains
+the SPMD GPT engine end-to-end."""
+
+import numpy as np
+import pytest
+
+from kubeml_tpu.api.errors import KubeMLError
+from kubeml_tpu.data.text import (
+    BYTE_VOCAB, EOS_ID, VocabTokenizer, byte_decode, byte_encode, pack_corpus)
+
+
+def test_byte_roundtrip():
+    s = "Hello, TPU! é漢"
+    ids = byte_encode(s)
+    assert ids.dtype == np.int32 and ids.min() >= 2 and ids.max() < BYTE_VOCAB
+    assert byte_decode(ids.tolist()) == s
+    # pad/eos stop decoding (generation rows pad after EOS)
+    assert byte_decode(byte_encode("ab").tolist() + [EOS_ID, 99]) == "ab"
+
+
+def test_pack_corpus_rows_and_eos():
+    corpus = "abc\n\ndefg\n\nhi"
+    rows, meta = pack_corpus(corpus, seq_len=4)
+    stream = rows.reshape(-1)
+    # every document is followed by EOS in the packed stream
+    assert (stream == EOS_ID).sum() >= 2  # the tail may be dropped
+    assert meta["documents"] == 3 and meta["tokenizer"] == "byte"
+    assert meta["vocab_size"] == BYTE_VOCAB
+    assert rows.shape[1] == 4 and rows.shape[0] == meta["rows"]
+    # decoded first doc text appears at the start
+    assert byte_decode(rows[0].tolist()).startswith("abc")
+
+
+def test_pack_corpus_rejections():
+    with pytest.raises(KubeMLError):
+        pack_corpus("", 8)
+    with pytest.raises(KubeMLError):
+        pack_corpus("tiny", 512)  # fewer tokens than one row
+    with pytest.raises(KubeMLError):
+        pack_corpus("abc", 1)
+
+
+def test_vocab_tokenizer_longest_match_and_errors():
+    tok = VocabTokenizer({"tokens": {"ab": 2, "a": 3, "b": 4, "abc": 5, " ": 6}})
+    assert tok.encode("abc ab a").tolist() == [5, 6, 2, 6, 3]
+    with pytest.raises(KubeMLError):
+        tok.encode("abz")  # no entry covers 'z'
+    with pytest.raises(KubeMLError):
+        VocabTokenizer({"tokens": {"x": 1}})  # reserved id
+    with pytest.raises(KubeMLError):
+        VocabTokenizer({"tokens": {}})
+    rows, meta = pack_corpus("ab a\n\nabc", 2,
+                             {"tokens": {"ab": 2, "a": 3, "b": 4, "abc": 5, " ": 6}})
+    assert meta["tokenizer"] == "vocab-json" and meta["vocab_size"] == 7
+
+
+def test_corpus_upload_via_storage_service(tmp_config):
+    """The wire form: POST /dataset/{name} with a corpus part creates a
+    packed token dataset readable by the shard store."""
+    import requests
+
+    from kubeml_tpu.storage.service import StorageService
+    from kubeml_tpu.storage.store import ShardStore
+
+    svc = StorageService(config=tmp_config).start()
+    try:
+        corpus = "\n\n".join(f"document number {i} with some text" for i in range(40))
+        files = {"corpus": ("c.txt", corpus.encode()), "seq-len": (None, "16")}
+        r = requests.post(f"{svc.url}/dataset/textset", files=files, timeout=60)
+        assert r.ok, r.text
+        body = r.json()
+        assert body["packing"]["tokenizer"] == "byte"
+        store = ShardStore(config=tmp_config)
+        x = store.get("textset").raw("train", "data")
+        assert x.shape[1] == 16 and np.issubdtype(x.dtype, np.integer)
+        assert store.get("textset").num_samples("test") >= 1
+        # bad uploads are 400s
+        bad = requests.post(f"{svc.url}/dataset/bad",
+                            files={"corpus": ("c.txt", b"x"),
+                                   "seq-len": (None, "512")}, timeout=60)
+        assert bad.status_code == 400
+    finally:
+        svc.stop()
+
+
+@pytest.mark.slow
+def test_text_corpus_trains_gpt_end_to_end(tmp_config):
+    """Done-criterion: upload text via the dataset API, train gpt-lm (spmd)
+    from it, and served generations decode back to text."""
+    import requests
+
+    from kubeml_tpu.api.types import GenerateRequest, TrainTask, TrainOptions, TrainRequest
+    from kubeml_tpu.functions.registry import FunctionRegistry
+    from kubeml_tpu.ps.parameter_server import ParameterServer
+    from kubeml_tpu.storage import HistoryStore, ShardStore
+    from kubeml_tpu.storage.service import StorageService
+
+    svc = StorageService(config=tmp_config).start()
+    try:
+        corpus = "\n\n".join(
+            "the quick brown fox jumps over the lazy dog" for _ in range(60))
+        files = {"corpus": ("c.txt", corpus.encode()), "seq-len": (None, "32")}
+        r = requests.post(f"{svc.url}/dataset/fox", files=files, timeout=60)
+        assert r.ok, r.text
+    finally:
+        svc.stop()
+
+    reg = FunctionRegistry(config=tmp_config)
+    reg.create("textlm", TEXT_LM_FN)
+    store = ShardStore(config=tmp_config)
+    ps = ParameterServer(registry=reg, store=store,
+                         history_store=HistoryStore(config=tmp_config),
+                         config=tmp_config)
+    req = TrainRequest(
+        model_type="custom", batch_size=8, epochs=2, dataset="fox", lr=3e-3,
+        function_name="textlm",
+        options=TrainOptions(engine="spmd", static_parallelism=True,
+                             default_parallelism=8, validate_every=1))
+    ps.start_task(TrainTask(job_id="textlm1", parameters=req))
+    assert ps.wait("textlm1", timeout=600)
+    hist = HistoryStore(config=tmp_config).get("textlm1")
+    assert len(hist.train_loss) == 2
+    assert hist.train_loss[-1] < hist.train_loss[0]  # it actually learns
+
+    prompt = byte_encode("the quick brown")[None].tolist()
+    out = ps.generate("textlm1", GenerateRequest(
+        model_id="textlm1", prompts=prompt, max_new_tokens=8))
+    text = byte_decode(out["tokens"][0])
+    assert isinstance(text, str)  # decodable bytes back out
+
+
+TEXT_LM_FN = """
+import optax
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.data.text import BYTE_VOCAB
+from kubeml_tpu.models.gpt import CausalTransformer
+from kubeml_tpu.runtime.model import KubeModel
+
+class DS(KubeDataset):
+    def __init__(self):
+        super().__init__("fox")
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(DS())
+    def build(self):
+        return CausalTransformer(vocab_size=BYTE_VOCAB, max_len=40,
+                                 embed_dim=64, depth=2, num_heads=4,
+                                 mesh=self.mesh)
+    def configure_optimizers(self):
+        return optax.adamw(self.lr)
+"""
