@@ -341,6 +341,10 @@ class MetricUpdate(_JsonMixin):
     train_loss: float = 0.0
     parallelism: int = 0
     epoch_duration: float = 0.0
+    # MoE expert-capacity overflow rate of the last epoch's steps (fraction
+    # of attempted top-k assignments dropped by the capacity limit);
+    # -1 = the model has no MoE layers (gauge omitted)
+    moe_overflow: float = -1.0
 
 
 @dataclass

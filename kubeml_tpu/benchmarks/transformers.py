@@ -104,6 +104,60 @@ def bench_bert(steps: int = 5, batch: int = 32, seq: int = 128) -> dict:
                        "bert-base-sst2", sample, labels, k=4, steps_cap=steps)
 
 
+def bench_moe(steps: int = 8, batch: int = 16, seq: int = 512) -> dict:
+    """MoE LM training MFU on one chip (VERDICT r4: chip-bench an MoE
+    config): GPT-2-small skeleton with routed experts every other block,
+    trained through the SPMD engine; reports MFU, the post-fusion roofline
+    ceiling, and the expert-capacity overflow rate."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..models.gpt import CausalTransformer
+    from ..parallel.mesh import make_mesh
+    from ..parallel.trainer import SPMDTrainer
+    from .mfu import compiled_costs, mfu_from, peak_flops, roofline_mfu
+
+    mesh = make_mesh(devices=jax.devices()[:1])
+    module = CausalTransformer(vocab_size=32000, max_len=seq, embed_dim=768,
+                               depth=12, num_heads=12, moe_every=2,
+                               num_experts=8, top_k=2, mesh=mesh,
+                               dtype=jnp.bfloat16)
+    trainer = SPMDTrainer(module, mesh, precision="bf16", batch_spec=P("dp"))
+    r = np.random.default_rng(0)
+    tokens = r.integers(1, 32000, size=(batch, seq)).astype(np.int32)
+    rng = jax.random.PRNGKey(0)
+    trainer.init(rng, tokens)
+    float(trainer.train_step(tokens, rng))  # compile + value-fetch drain
+    best = 0.0
+    for rep in range(3):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            loss = trainer.train_step(tokens, jax.random.fold_in(rng, i))
+        float(loss)
+        best = max(best, steps * batch * seq / (time.perf_counter() - t0))
+    costs = compiled_costs(trainer._step_fn, trainer.params, trainer.opt_state,
+                           jnp.asarray(tokens), rng)
+    flops = costs["flops"]
+    steps_per_sec = best / (batch * seq)
+    mfu = mfu_from(flops, steps_per_sec)
+    ceiling = roofline_mfu(flops, costs["bytes_hbm"])
+    return {
+        "metric": "gpt-moe-train-throughput",
+        "value": round(best, 1),
+        "unit": "tokens/sec",
+        "batch": batch,
+        "seq": seq,
+        "num_experts": 8,
+        "top_k": 2,
+        "moe_every": 2,
+        "flops_per_step": flops,
+        "peak_flops": peak_flops(),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "roofline_mfu_ceiling": round(ceiling, 4) if ceiling is not None else None,
+        "moe_overflow": round(float(trainer.last_moe_overflow), 4),
+        "loss": round(float(loss), 4),
+    }
+
+
 def sweep_bert(steps: int = 5, batches=(32, 64, 128, 256)) -> List[dict]:
     """The MFU lever sweep (VERDICT r2 #3: BERT-base sat at 30% — is the
     ceiling per-core batch?): per-chip batch doubles until HBM pushes back.
@@ -128,7 +182,8 @@ def sweep_bert(steps: int = 5, batches=(32, 64, 128, 256)) -> List[dict]:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="transformer training headline benchmark")
-    p.add_argument("--model", choices=["vit-tiny", "bert-base", "all"], default="all")
+    p.add_argument("--model", choices=["vit-tiny", "bert-base", "gpt-moe", "all"],
+                   default="all")
     p.add_argument("--steps", type=int, default=None)
     p.add_argument("--sweep", action="store_true",
                    help="BERT per-chip batch sweep with roofline ceilings")
@@ -147,6 +202,9 @@ def main(argv=None) -> int:
         print(json.dumps(results[-1]))
     if args.model in ("bert-base", "all"):
         results.append(bench_bert(args.steps or 5, batch=args.batch or 32))
+        print(json.dumps(results[-1]))
+    if args.model in ("gpt-moe", "all"):
+        results.append(bench_moe(args.steps or 8, batch=args.batch or 16))
         print(json.dumps(results[-1]))
     return 0
 

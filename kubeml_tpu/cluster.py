@@ -78,7 +78,7 @@ class LocalCluster:
         self.scheduler_api: Optional[SchedulerAPI] = None
         self.ps_api: Optional[PSAPI] = None
 
-    def start(self) -> "LocalCluster":
+    def start(self, recover: bool = True) -> "LocalCluster":
         self.cfg.enable_compilation_cache()
         self.scheduler.start()
         if self.serve_http:
@@ -87,6 +87,17 @@ class LocalCluster:
             self.scheduler_api = SchedulerAPI(self.scheduler, config=self.cfg).start()
             self.ps_api = PSAPI(self.ps, config=self.cfg).start()
             log.info("kubeml-tpu cluster up: controller at %s", self.controller.url)
+        if recover:
+            # crash recovery (deployment supervision): jobs journaled by a
+            # previous life resubmit with resume=True — a supervised restart
+            # continues interrupted work from its newest checkpoint without
+            # operator action. No-op on a clean boot (empty journal).
+            try:
+                n = self.ps._journal.recover_into(self.scheduler)
+                if n:
+                    log.info("recovered %d interrupted job(s) from the journal", n)
+            except Exception:
+                log.exception("journal recovery failed (non-fatal)")
         return self
 
     def stop(self) -> None:

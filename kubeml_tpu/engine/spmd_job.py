@@ -531,12 +531,17 @@ class SPMDJob:
         if self.on_metrics is None:
             return
         try:
+            overflow = -1.0
+            last = getattr(self.trainer, "last_moe_overflow", None)
+            if last is not None:
+                overflow = float(last)  # -1 sentinel for dense models
             self.on_metrics(MetricUpdate(
                 job_id=self.job_id, train_loss=float(train_loss),
                 validation_loss=float(val_loss) if val_loss is not None else 0.0,
                 accuracy=float(acc_pct) if acc_pct is not None else 0.0,
                 parallelism=parallelism,
                 epoch_duration=float(elapsed),
+                moe_overflow=overflow,
             ))
         except Exception:
             log.exception("%s: metrics push failed (non-fatal)", self.job_id)

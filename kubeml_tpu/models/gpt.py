@@ -255,6 +255,10 @@ class CausalTransformer(nn.Module):
     moe_every: int = 0
     num_experts: int = 8
     top_k: int = 2
+    # per-expert capacity at TRAINING time (Switch-style; overflow falls
+    # through the residual). Decode always routes uncapped — capacity
+    # competition is not causally consistent (parallel/moe.py)
+    moe_capacity: float = 1.25
 
     @nn.compact
     def __call__(self, token_ids, train: bool = False, decode: bool = False,
@@ -280,9 +284,6 @@ class CausalTransformer(nn.Module):
                              _part((None, None, "tp"))(nn.initializers.normal(0.02)),
                              (1, self.max_len, self.embed_dim))
         if decode:
-            if self.moe_every > 0:
-                raise ValueError("KV-cache decode is dense-blocks only; "
-                                 "moe_every must be 0 for generation")
             # absolute positions continue from the shared cache cursor (the
             # per-layer attention caches keep their own identical copies; this
             # one feeds the position embedding / exists for parity under rope)
@@ -313,10 +314,14 @@ class CausalTransformer(nn.Module):
                 from ..parallel.moe import MoEBlock
 
                 x = MoEBlock(self.num_heads, self.num_experts, self.mlp_ratio,
-                             self.top_k, self.dropout, mesh=self.mesh,
+                             self.top_k, self.moe_capacity, self.dropout,
+                             mesh=self.mesh,
                              sp_impl=self.sp_impl, dtype=self.dtype,
                              rope=use_rope, rope_theta=self.rope_theta,
-                             name=f"block_{i}")(x, valid, train=train)
+                             cache_len=self.max_len if decode else 0,
+                             name=f"block_{i}")(x, valid, train=train,
+                                                decode=decode,
+                                                positions=positions)
             else:
                 # static_argnums counts self as 0, so `train` (a trace-time
                 # bool steering dropout determinism) is positional arg 3 and

@@ -44,7 +44,8 @@ class MoEMlp(nn.Module):
     router_noise: float = 1e-2
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, train: bool = False,
+                 decode: bool = False) -> jnp.ndarray:
         B, L, D = x.shape
         E = self.num_experts
         S = B * L
@@ -63,6 +64,40 @@ class MoEMlp(nn.Module):
             rng = self.make_rng("dropout")
             logits = logits + self.router_noise * jax.random.normal(rng, logits.shape)
         gates = jax.nn.softmax(logits, axis=-1)  # [S, E]
+
+        # expert weights (shared by both routing paths below)
+        w_in = self.param(
+            "w_in", _part(("ep", None, "tp"))(nn.initializers.lecun_normal()), (E, D, H)
+        )
+        w_out = self.param(
+            "w_out", _part(("ep", "tp", None))(nn.initializers.lecun_normal()), (E, H, D)
+        )
+
+        if decode:
+            # Serving path: UNCAPPED top-k routing (standard no-token-dropping
+            # inference). Capacity competition makes a token's output depend
+            # on how many OTHER tokens already claimed its expert's slots —
+            # not causally consistent, so KV-cache incremental decode could
+            # never reproduce a capped full forward. Without the cap each
+            # token routes independently: decode steps route exactly like a
+            # full forward. Cost: every expert runs on every token (gates
+            # zero the non-chosen ones) — E/top_k x the dense-MLP FLOPs, the
+            # price of causal consistency; on decode STEPS the token count
+            # is the slot count, and PREFILL scans over experts so peak
+            # memory stays [S, H] per expert instead of an [E, S, H] slab.
+            kth = jax.lax.top_k(gates, self.top_k)[0][:, -1:]
+            keep = (gates >= kth).astype(jnp.float32) * gates
+            keep = keep / jnp.maximum(keep.sum(-1, keepdims=True), 1e-9)
+            keep = keep.astype(tokens.dtype)
+
+            def one_expert(acc, ws):
+                w_i, w_o, k_e = ws  # [D, H], [H, D], [S]
+                h = jax.nn.gelu(tokens @ w_i.astype(tokens.dtype))
+                return acc + k_e[:, None] * (h @ w_o.astype(tokens.dtype)), None
+
+            out, _ = jax.lax.scan(
+                one_expert, jnp.zeros_like(tokens), (w_in, w_out, keep.T))
+            return out.reshape(B, L, D)
 
         # --- top-k dispatch with capacity (GShard-style) ---
         # Queue positions must be offset by the tokens already enqueued for the
@@ -100,14 +135,17 @@ class MoEMlp(nn.Module):
             aux = E * jnp.sum(frac_routed.astype(jnp.float32) * mean_gate)
             self.sow("aux_loss", "moe", self.aux_loss_weight * aux,
                      reduce_fn=lambda _, b: b)
+            # capacity-overflow telemetry: fraction of attempted top-k
+            # assignments dropped by the capacity limit (those tokens fall
+            # through the residual). Sown into its own collection so the
+            # trainer can surface it on /metrics without touching the loss.
+            asked = jnp.float32(S * self.top_k)
+            kept = dispatch.astype(jnp.float32).sum()
+            self.sow("moe_stats", "overflow",
+                     1.0 - kept / jnp.maximum(asked, 1.0),
+                     reduce_fn=lambda _, b: b)
 
         # --- expert FFNs ([E, cap, D] per-expert batches, ep-sharded) ---
-        w_in = self.param(
-            "w_in", _part(("ep", None, "tp"))(nn.initializers.lecun_normal()), (E, D, H)
-        )
-        w_out = self.param(
-            "w_out", _part(("ep", "tp", None))(nn.initializers.lecun_normal()), (E, H, D)
-        )
         expert_in = jnp.einsum("sec,sd->ecd", dispatch, tokens)  # a2a via sharding
         h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", expert_in, w_in.astype(tokens.dtype)))
         expert_out = jnp.einsum("ech,ehd->ecd", h, w_out.astype(tokens.dtype))
@@ -122,22 +160,30 @@ class MoEBlock(nn.Module):
     num_experts: int = 8
     mlp_ratio: int = 4
     top_k: int = 2
+    capacity_factor: float = 1.25
     dropout: float = 0.0
     mesh: Optional[object] = None  # jax.sharding.Mesh; for sp attention
     sp_impl: str = "ring"
     dtype: object = jnp.float32  # computation dtype (router stays f32)
     rope: bool = False  # rotary q/k (ops.rotary), forwarded by the parent
     rope_theta: float = 10000.0
+    # KV-cache capacity for autoregressive decode (set by the parent from
+    # max_len); the expert MLP is position-free, so serving an MoE model is
+    # just the attention cache path plus routing the stepped tokens
+    cache_len: int = 0
 
     @nn.compact
-    def __call__(self, x, valid, train: bool = False):
+    def __call__(self, x, valid, train: bool = False, decode: bool = False,
+                 positions=None):
         from ..models.gpt import CausalSelfAttention
 
         y = nn.LayerNorm(name="ln1", dtype=jnp.float32)(x).astype(self.dtype)
         y = CausalSelfAttention(self.num_heads, mesh=self.mesh,
                                 sp_impl=self.sp_impl, dtype=self.dtype,
                                 rope=self.rope, rope_theta=self.rope_theta,
-                                name="attn")(y, valid)
+                                cache_len=self.cache_len,
+                                name="attn")(y, valid, decode=decode,
+                                             positions=positions)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(name="ln2", dtype=jnp.float32)(x).astype(self.dtype)
@@ -145,8 +191,9 @@ class MoEBlock(nn.Module):
             num_experts=self.num_experts,
             mlp_ratio=self.mlp_ratio,
             top_k=self.top_k,
+            capacity_factor=self.capacity_factor,
             name="moe",
-        )(y, train=train)
+        )(y, train=train, decode=decode)
         return x + y
 
 

@@ -128,6 +128,9 @@ class SPMDTrainer:
         self._step_fn = None
         self.params = None
         self.opt_state = None
+        # expert-capacity overflow rate of the last step (device scalar;
+        # -1 sentinel when the model has no MoE layers)
+        self.last_moe_overflow = None
 
     # --- init ---
 
@@ -182,12 +185,14 @@ class SPMDTrainer:
         def step(variables, opt_state, batch, rng):
             def compute_loss(params):
                 vs = {**variables, "params": params}
-                # mutable aux_loss collects router load-balancing penalties sown
-                # by MoE layers (kubeml_tpu.parallel.moe); empty otherwise
+                # mutable collections: aux_loss collects router load-balancing
+                # penalties sown by MoE layers (kubeml_tpu.parallel.moe);
+                # moe_stats carries their capacity-overflow telemetry; both
+                # empty for dense models
                 if logits_chunk is not None:
                     hidden, sown = module.apply(
                         vs, cast(batch), train=True, rngs={"dropout": rng},
-                        mutable=["aux_loss"], return_hidden=True,
+                        mutable=["aux_loss", "moe_stats"], return_hidden=True,
                     )
                     kernel = nn.meta.unbox(params)["lm_head"]["kernel"]
                     loss = chunked_lm_loss(hidden, kernel.astype(hidden.dtype),
@@ -195,29 +200,35 @@ class SPMDTrainer:
                 else:
                     logits, sown = module.apply(
                         vs, cast(batch), train=True, rngs={"dropout": rng},
-                        mutable=["aux_loss"],
+                        mutable=["aux_loss", "moe_stats"],
                     )
                     loss = loss_fn(logits.astype(jnp.float32), batch)
                 for leaf in jax.tree.leaves(sown.get("aux_loss", {})):
                     loss = loss + jnp.sum(leaf)
-                return loss
+                stats = jax.tree.leaves(sown.get("moe_stats", {}))
+                overflow = (sum(jnp.mean(s) for s in stats) / len(stats)
+                            if stats else jnp.float32(-1.0))  # -1 = no MoE
+                return loss, overflow
 
-            loss, grads = jax.value_and_grad(compute_loss)(variables["params"])
+            (loss, overflow), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(variables["params"])
             updates, opt_next = tx.update(grads, opt_state, variables["params"])
             params = optax.apply_updates(variables["params"], updates)
-            return {**variables, "params": params}, opt_next, loss
+            return {**variables, "params": params}, opt_next, loss, overflow
 
         batch_sharding = NamedSharding(self.mesh, self.batch_spec)
         replicated = NamedSharding(self.mesh, P())
         return jax.jit(
             step,
             in_shardings=(self._param_shardings, self._opt_shardings, batch_sharding, replicated),
-            out_shardings=(self._param_shardings, self._opt_shardings, replicated),
+            out_shardings=(self._param_shardings, self._opt_shardings, replicated, replicated),
             donate_argnums=(0, 1) if self.donate else (),
         )
 
     def train_step(self, batch: np.ndarray, rng: jax.Array) -> float:
-        """One optimizer step on a global batch; returns the (device) loss."""
+        """One optimizer step on a global batch; returns the (device) loss.
+        MoE models additionally leave their expert-capacity overflow rate in
+        ``last_moe_overflow`` (a device scalar; -1 sentinel for dense)."""
         if self.params is None:
             raise RuntimeError("call init() before train_step()")
         if self._step_fn is None:
@@ -225,7 +236,7 @@ class SPMDTrainer:
             log.info("compiling SPMD step: mesh=%s batch=%s",
                      dict(self.mesh.shape), np.shape(batch))
         with jax.set_mesh(self.mesh):
-            self.params, self.opt_state, loss = self._step_fn(
+            self.params, self.opt_state, loss, self.last_moe_overflow = self._step_fn(
                 self.params, self.opt_state, jnp.asarray(batch), rng
             )
         return loss

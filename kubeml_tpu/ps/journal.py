@@ -1,0 +1,77 @@
+"""Running-job journal — crash recovery state for deployment supervision.
+
+The reference gets restart-and-resume from Kubernetes: pods restart via the
+Deployment controller and jobs are simply lost (weights died with RedisAI —
+SURVEY §5). Here supervision must actually RESUME work: the PS journals every
+accepted job to disk (one JSON file per live job), clears it on finish, and a
+rebooting control plane resubmits whatever is left with ``resume=True`` — so
+a kill -9 anywhere in the fleet costs at most the epochs since the newest
+checkpoint (deploy/supervise + TrainOptions.checkpoint_every).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import List, Optional
+
+from ..api.config import Config, get_config
+from ..api.types import TrainRequest
+
+log = logging.getLogger("kubeml.journal")
+
+
+class JobJournal:
+    def __init__(self, config: Optional[Config] = None):
+        cfg = config or get_config()
+        self.dir = cfg.data_root / "journal"
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, job_id: str) -> Path:
+        return self.dir / f"{job_id}.json"
+
+    def record(self, job_id: str, request: TrainRequest) -> None:
+        """Persist an accepted job (atomic publish; crash-safe)."""
+        tmp = self._path(job_id).with_suffix(".tmp")
+        tmp.write_text(json.dumps({"job_id": job_id,
+                                   "request": request.to_dict()}))
+        tmp.replace(self._path(job_id))
+
+    def clear(self, job_id: str) -> None:
+        self._path(job_id).unlink(missing_ok=True)
+
+    def pending(self) -> List[dict]:
+        """Journaled jobs from a previous life (the crash-recovery set)."""
+        out = []
+        for p in sorted(self.dir.glob("*.json")):
+            try:
+                out.append(json.loads(p.read_text()))
+            except ValueError:
+                log.warning("journal entry %s is corrupt; skipping", p.name)
+        return out
+
+    def recover_into(self, scheduler) -> int:
+        """Resubmit every journaled job with ``resume=True`` (keeping its job
+        id so it re-attaches to its own checkpoints). Returns the count.
+
+        The journal entry is NOT cleared here: submit_train only ENQUEUES
+        (the job may sit queued for minutes behind other work), and a crash
+        in that window is exactly the scenario supervision exists for — the
+        entry must survive so the NEXT boot recovers it again. The PS
+        re-records the entry on start (idempotent overwrite) and clears it
+        when the job actually finishes; recovery itself is idempotent
+        because resume restores the newest checkpoint."""
+        n = 0
+        for entry in self.pending():
+            job_id = entry.get("job_id", "")
+            try:
+                req = TrainRequest.from_dict(entry.get("request", {}))
+                req.job_id = job_id
+                req.options.resume = True
+                scheduler.submit_train(req)
+                n += 1
+                log.info("recovered job %s (resubmitted with resume)", job_id)
+            except Exception:
+                log.exception("recovering job %s failed", job_id)
+        return n

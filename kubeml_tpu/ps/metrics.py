@@ -20,6 +20,9 @@ GAUGES = {
     "kubeml_job_train_loss": "Train loss of a train job",
     "kubeml_job_parallelism": "Parallelism of a train job",
     "kubeml_job_epoch_duration_seconds": "Duration of the last epoch",
+    # extension: MoE expert-capacity overflow (dropped top-k assignment
+    # fraction); series exists only for jobs whose model routes experts
+    "kubeml_job_moe_overflow": "MoE expert-capacity overflow rate",
 }
 RUNNING = "kubeml_job_running_total"
 
@@ -40,6 +43,8 @@ class MetricsRegistry:
             self._values[("kubeml_job_train_loss", jid)] = u.train_loss
             self._values[("kubeml_job_parallelism", jid)] = float(u.parallelism)
             self._values[("kubeml_job_epoch_duration_seconds", jid)] = u.epoch_duration
+            if u.moe_overflow >= 0.0:
+                self._values[("kubeml_job_moe_overflow", jid)] = u.moe_overflow
 
     def clear(self, job_id: str) -> None:
         """Drop a finished job's series (reference: metrics.go:100-106)."""

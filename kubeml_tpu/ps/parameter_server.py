@@ -68,6 +68,10 @@ class _JobRecord:
     # standalone mode (reference: dedicated job pod, ps/job_pod.go)
     proc: Optional[object] = None  # subprocess.Popen
     url: Optional[str] = None  # the runner's HTTP endpoint
+    # a job killed by a TRANSIENT fault (accelerator RPC, a peer process
+    # dying) keeps its journal entry so the next supervised boot resubmits
+    # it with resume=True — clearing it would turn crash recovery into a no-op
+    keep_journal: bool = False
 
 
 class ParameterServer:
@@ -94,6 +98,11 @@ class ParameterServer:
         self._socket_cache: Dict[str, tuple] = {}  # (model, vars, epoch version)
         self._decoders: Dict[str, tuple] = {}  # (BatchingDecoder, ckpt mtime)
         self._ckpt_store = CheckpointStore(config=self.cfg)
+        from .journal import JobJournal
+
+        # crash-recovery journal: accepted jobs persist until they finish so
+        # a supervised restart resubmits them with resume=True (deploy docs)
+        self._journal = JobJournal(config=self.cfg)
         self._lock = threading.RLock()
         # multi-host: the PS runs on process 0 and announces each job to the
         # follower processes over the host channel; jobs serialize on
@@ -176,6 +185,10 @@ class ParameterServer:
             self._jobs[task.job_id] = placeholder
             self._serving_cache.pop(task.job_id, None)
             self._socket_cache.pop(task.job_id, None)
+        try:
+            self._journal.record(task.job_id, task.parameters)
+        except Exception:
+            log.exception("journaling job %s failed (non-fatal)", task.job_id)
         return placeholder
 
     def _ensure_failure_history(self, job_id: str, request, error: str) -> None:
@@ -200,6 +213,10 @@ class ParameterServer:
         task.status = JobStateEnum.FAILED
         with self._lock:
             self._jobs.pop(task.job_id, None)
+        try:
+            self._journal.clear(task.job_id)
+        except Exception:
+            pass
         self.history_store.save(History(
             id=task.job_id,
             task={"request": task.parameters.to_dict(), "error": str(error)},
@@ -492,6 +509,13 @@ class ParameterServer:
         except Exception as e:
             task.status = JobStateEnum.FAILED
             log.error("job %s failed: %s", task.job_id, e)
+            from ..engine.failures import is_transient_accelerator_error
+
+            if record is not None and is_transient_accelerator_error(e):
+                # crash-class failure (accelerator RPC fault, a peer process
+                # dying): keep the journal entry so a supervised restart
+                # resubmits this job with resume=True
+                record.keep_journal = True
         finally:
             # expect guards a thread that was ABANDONED by the heartbeat
             # monitor and wakes later: its slot may now belong to a
@@ -512,6 +536,11 @@ class ParameterServer:
                 return False
             self._jobs.pop(job_id, None)
             self._socket_cache.pop(job_id, None)  # socket dies with the runner
+        if not record.keep_journal:
+            try:
+                self._journal.clear(job_id)
+            except Exception:
+                log.exception("clearing journal for %s failed (non-fatal)", job_id)
         self.metrics.clear(job_id)
         self.metrics.task_finished("train")
         if self.scheduler is not None:
@@ -773,8 +802,6 @@ class ParameterServer:
             return None
         if "decode" not in params or "positions" not in params:
             return None
-        if getattr(module, "moe_every", 0):
-            return None  # MoE decode serves through the one-shot path
         mtime = self._serving_cache.get(model_id)
         mtime = mtime[2] if mtime else None
         with self._lock:

@@ -25,6 +25,7 @@ def main() -> int:
         assert v["i"] == i
     if not dist.is_leader:
         print("RESULT follower_ok", flush=True)
+        dist.put("kubeml/test-exit/1", "1")  # see exit alignment below
         return 0
 
     def present(key):
@@ -39,6 +40,11 @@ def main() -> int:
     recent_present = present(f"kubeml/bcast/{n - 1}")
     print(f"RESULT old_deleted={old_deleted} recent_present={recent_present}",
           flush=True)
+    # exit alignment (same as multihost_proc.py): the leader hosts the
+    # coordination service and must exit LAST or the follower's agent FATALs
+    # with a dirty returncode. Follower PUTs an exit key (no reads), leader
+    # collects it before exiting.
+    dist.get("kubeml/test-exit/1", timeout_s=30)
     return 0
 
 

@@ -1,0 +1,18 @@
+"""Child entry for the supervision test: force the CPU platform (env vars
+don't work here — sitecustomize imports jax first), then run the real
+``kubeml start``. The supervisor launches this exactly like it would launch
+``python -m kubeml_tpu.cli start`` in production."""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices",
+                  int(os.environ.get("KUBEML_TEST_LOCAL_DEVICES", "2")))
+
+from kubeml_tpu.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(["start"]))

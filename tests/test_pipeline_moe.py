@@ -209,3 +209,37 @@ def test_moe_routing_is_total_without_capacity_pressure(rng):
     variables = probe.init(jax.random.PRNGKey(0), x)
     out = probe.apply(variables, x)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_moe_overflow_telemetry(tmp_path):
+    """Expert-capacity overflow surfaces end-to-end: the trainer records the
+    sown rate, the metrics registry renders the kubeml_job_moe_overflow
+    gauge, and dense models keep the -1 sentinel (no gauge series)."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from kubeml_tpu.api.types import MetricUpdate
+    from kubeml_tpu.parallel.mesh import make_mesh
+    from kubeml_tpu.parallel.moe import MoETiny
+    from kubeml_tpu.parallel.trainer import SPMDTrainer
+    from kubeml_tpu.ps.metrics import MetricsRegistry
+
+    mesh = make_mesh(dp=4, ep=2)
+    m = MoETiny(vocab_size=64, max_len=16, num_experts=4, mesh=mesh)
+    trainer = SPMDTrainer(m, mesh, precision="f32", batch_spec=P("dp"))
+    r = np.random.default_rng(0)
+    batch = r.integers(1, 64, size=(8, 16)).astype(np.int32)
+    trainer.init(jax.random.PRNGKey(0), batch)
+    trainer.train_step(batch, jax.random.PRNGKey(1))
+    overflow = float(trainer.last_moe_overflow)
+    assert 0.0 <= overflow <= 1.0
+
+    reg = MetricsRegistry()
+    reg.update(MetricUpdate(job_id="moejob", train_loss=1.0, parallelism=8,
+                            moe_overflow=overflow))
+    reg.update(MetricUpdate(job_id="densejob", train_loss=1.0, parallelism=8))
+    text = reg.render()
+    assert f'kubeml_job_moe_overflow{{jobid="moejob"}} {overflow}' in text
+    assert 'kubeml_job_moe_overflow{jobid="densejob"}' not in text
