@@ -1,0 +1,53 @@
+"""Mixture-of-Experts causal LM — expert parallelism over the ``ep`` axis
+(no reference counterpart; SURVEY §2.4 lists expert parallelism as absent).
+
+Deploy and train with experts sharded across chips:
+
+    python -m kubeml_tpu.cli function create -n moelm --code examples/function_moe_lm.py
+    python -m kubeml_tpu.cli train -f moelm -d tokens -e 10 -b 64 --lr 3e-4 \
+        --engine spmd --mesh ep=4,tp=2
+
+Every other block's MLP is replaced by routed experts (Switch-style top-2
+with a capacity limit at training time); the router's load-balancing loss is
+collected automatically, and the expert-capacity overflow rate shows up on
+the PS ``/metrics`` as ``kubeml_job_moe_overflow``. A finished (or live
+single-host) job serves ``kubeml generate`` like any causal LM — decode
+routes uncapped (no token dropping), see kubeml_tpu/parallel/moe.py."""
+
+import jax.numpy as jnp
+import optax
+
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.models.gpt import CausalTransformer
+from kubeml_tpu.runtime.model import KubeModel
+
+
+class Tokens(KubeDataset):
+    def __init__(self):
+        super().__init__("tokens")
+
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(Tokens())
+
+    def build(self):
+        return CausalTransformer(
+            vocab_size=32000,
+            max_len=1024,
+            embed_dim=768,
+            depth=12,
+            num_heads=12,
+            moe_every=2,       # every 2nd block routes experts
+            num_experts=8,
+            top_k=2,
+            mesh=self.mesh,    # ep axis shards the expert stacks
+            dtype=jnp.bfloat16,
+        )
+
+    def configure_optimizers(self):
+        return optax.adamw(self.lr)
+
+
+def main():
+    return Model()

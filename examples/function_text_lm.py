@@ -1,0 +1,59 @@
+"""Byte-level LM trained from a RAW TEXT corpus (round-4 text data path;
+the reference's dataset pipeline accepts numpy arrays only,
+/root/reference/python/storage/api.py:105-142).
+
+End-to-end from a .txt file to served generation:
+
+    # upload: blank lines separate documents; server tokenizes + packs
+    python -m kubeml_tpu.cli dataset create-text -n corpus \
+        --corpus my_text.txt --seq-len 256
+
+    python -m kubeml_tpu.cli function create -n textlm --code examples/function_text_lm.py
+    python -m kubeml_tpu.cli train -f textlm -d corpus -e 20 -b 64 --lr 3e-3 \
+        --engine spmd
+
+    # prompts are byte tokens; decode the served generation back to text:
+    #   from kubeml_tpu.data.text import byte_encode, byte_decode
+    #   out = client.networks().generate(job_id, byte_encode("Once upon")[None])
+    #   print(byte_decode(out["tokens"][0]))
+
+The byte tokenizer (PAD=0, EOS=1, byte b -> b+2; vocab 258) needs no
+downloads and round-trips losslessly; supply a vocab-JSON asset to
+``dataset create-text --tokenizer`` for a custom vocabulary instead."""
+
+import jax.numpy as jnp
+import optax
+
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.data.text import BYTE_VOCAB
+from kubeml_tpu.models.gpt import CausalTransformer
+from kubeml_tpu.runtime.model import KubeModel
+
+
+class Corpus(KubeDataset):
+    def __init__(self):
+        super().__init__("corpus")
+
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(Corpus())
+
+    def build(self):
+        return CausalTransformer(
+            vocab_size=BYTE_VOCAB,
+            max_len=256,
+            embed_dim=512,
+            depth=8,
+            num_heads=8,
+            pos="rope",       # no position table; extrapolates past max_len
+            mesh=self.mesh,
+            dtype=jnp.bfloat16,
+        )
+
+    def configure_optimizers(self):
+        return optax.adamw(self.lr)
+
+
+def main():
+    return Model()

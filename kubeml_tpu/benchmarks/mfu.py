@@ -214,6 +214,12 @@ def post_fusion_bytes(hlo_text: str) -> Optional[float]:
                 # inner computations' parameters alias buffers already
                 # counted at their definition site — outputs only
                 total += comp_traffic(sub, seen | {id(sub)}, False)
+            if called:
+                # the while/conditional/call op's own output aliases its
+                # traversed body's ROOT (already counted) — adding it again
+                # would double-count the loop carry (params + opt state, the
+                # dominant buffers) and break the at-or-above guarantee
+                continue
             if opcode == "parameter":
                 if count_params:
                     total += out_bytes  # program inputs: read once

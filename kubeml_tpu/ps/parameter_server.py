@@ -435,10 +435,12 @@ class ParameterServer:
         return pruned
 
     def shutdown_standalone_jobs(self) -> None:
-        """Terminate any live job runner processes (cluster stop)."""
+        """Terminate any live job runner processes (cluster stop). Shutdown,
+        not user /stop: journals survive for restart-and-resume."""
         with self._lock:
             records = [r for r in self._jobs.values() if r.proc is not None]
         for r in records:
+            r.keep_journal = True
             try:
                 r.proc.terminate()
             except Exception:
@@ -485,12 +487,18 @@ class ParameterServer:
     def stop_running_jobs(self) -> None:
         """Cooperative stop for every threaded job (multi-host shutdown must
         stop the running job FIRST — announce_shutdown waits on the dist lock
-        its thread holds)."""
+        its thread holds).
+
+        This is the SHUTDOWN path, not a user /stop: the stopped jobs keep
+        their journal entries so a supervised rolling restart resubmits them
+        with resume=True — clearing here would make routine deploy restarts
+        lose work that a kill -9 would have recovered."""
         with self._lock:
-            jobs = [r.job for r in self._jobs.values() if r.job is not None]
-        for job in jobs:
+            records = [r for r in self._jobs.values() if r.job is not None]
+        for record in records:
+            record.keep_journal = True
             try:
-                job.stop()
+                record.job.stop()
             except Exception:
                 log.exception("stopping job failed")
 
@@ -506,6 +514,10 @@ class ParameterServer:
             task.status = (
                 JobStateEnum.STOPPED if job.stop_event.is_set() else JobStateEnum.FINISHED
             )
+            if record is not None and task.status == JobStateEnum.FINISHED:
+                # a job that completed during shutdown must not be resubmitted
+                # on the next boot, even if the shutdown path flagged it
+                record.keep_journal = False
         except Exception as e:
             task.status = JobStateEnum.FAILED
             log.error("job %s failed: %s", task.job_id, e)

@@ -68,6 +68,12 @@ class Supervisor:
         while not self._stop:
             log.info("starting child: %s", " ".join(self.command))
             self._child = subprocess.Popen(self.command, env=self.env)
+            if self._stop:
+                # SIGTERM landed between the loop check and Popen: the
+                # handler saw no (or the previous) child, so terminate this
+                # one ourselves or the supervisor blocks in wait() forever
+                # with an orphan holding the service ports
+                self._child.terminate()
             if self.pidfile is not None:
                 self.pidfile.write_text(str(self._child.pid))
             rc = self._child.wait()

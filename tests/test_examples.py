@@ -19,7 +19,8 @@ def registry(tmp_config):
 
 @pytest.mark.parametrize("name", ["function_lenet", "function_resnet34",
                                   "function_vgg11", "function_vit",
-                                  "function_gpt_spmd"])
+                                  "function_gpt_spmd", "function_moe_lm",
+                                  "function_text_lm"])
 def test_example_deploys_and_builds(registry, name):
     source = (EXAMPLES / f"{name}.py").read_text()
     registry.create(name, source)
@@ -28,7 +29,8 @@ def test_example_deploys_and_builds(registry, name):
     assert module is not None
     tx = model.configure_optimizers()
     assert hasattr(tx, "update")
-    if name != "function_gpt_spmd":  # image models: uint8 device pipeline
+    if name not in ("function_gpt_spmd", "function_moe_lm",
+                    "function_text_lm"):  # image models: uint8 device pipeline
         import jax.numpy as jnp
 
         x = jnp.asarray(np.random.default_rng(0).integers(

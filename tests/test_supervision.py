@@ -178,3 +178,71 @@ def test_follower_kill9_resumes_without_operator(tmp_path):
             s.stop()
         for t in threads:
             t.join(30)
+
+
+def test_shutdown_stop_keeps_journal_finished_clears(tmp_config):
+    """A SHUTDOWN-driven stop keeps the journal (rolling restarts resume the
+    job) while normal completion clears it — the distinction that makes
+    supervised deploy restarts lossless."""
+    import numpy as np
+
+    from kubeml_tpu.api.types import JobStateEnum, TrainOptions, TrainRequest, TrainTask
+    from kubeml_tpu.functions.registry import FunctionRegistry
+    from kubeml_tpu.ps.parameter_server import ParameterServer
+    from kubeml_tpu.storage import HistoryStore, ShardStore
+
+    store = ShardStore(config=tmp_config)
+    r = np.random.default_rng(0)
+    x = r.normal(size=(64, 16, 16, 1)).astype(np.float32)
+    y = r.integers(0, 4, size=(64,)).astype(np.int64)
+    store.create("blobs", x, y, x[:16], y[:16])
+    reg = FunctionRegistry(config=tmp_config)
+    reg.create("jfn", JOURNAL_FN)
+    ps = ParameterServer(registry=reg, store=store,
+                         history_store=HistoryStore(config=tmp_config),
+                         config=tmp_config)
+
+    def submit(jid, epochs):
+        t = TrainTask(job_id=jid, parameters=TrainRequest(
+            model_type="custom", batch_size=16, epochs=epochs, dataset="blobs",
+            lr=0.01, function_name="jfn",
+            options=TrainOptions(default_parallelism=2, k=1, validate_every=0)))
+        ps.start_task(t)
+        return t
+
+    # long job, shutdown-stopped mid-flight: journal entry SURVIVES
+    t1 = submit("jrnl1", 50)
+    deadline = time.time() + 120
+    while time.time() < deadline and not ps._journal.pending():
+        time.sleep(0.1)
+    ps.stop_running_jobs()
+    assert ps.wait("jrnl1", timeout=300)
+    assert t1.status == JobStateEnum.STOPPED
+    assert [e["job_id"] for e in ps._journal.pending()] == ["jrnl1"]
+    ps._journal.clear("jrnl1")
+
+    # short job that COMPLETES: journal entry cleared
+    t2 = submit("jrnl2", 1)
+    assert ps.wait("jrnl2", timeout=300)
+    assert t2.status == JobStateEnum.FINISHED
+    assert ps._journal.pending() == []
+
+
+JOURNAL_FN = """
+import optax
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.models.lenet import LeNet
+from kubeml_tpu.runtime.model import KubeModel
+
+class DS(KubeDataset):
+    def __init__(self):
+        super().__init__("blobs")
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(DS())
+    def build(self):
+        return LeNet(num_classes=4)
+    def configure_optimizers(self):
+        return optax.sgd(self.lr)
+"""
