@@ -103,22 +103,69 @@ def cmd_infer(args) -> int:
 def cmd_generate(args) -> int:
     import numpy as np
 
-    prompts = np.load(args.datafile, allow_pickle=False)
+    if args.text is not None:
+        if args.output:
+            print("error: --output applies to token-array mode; --text "
+                  "prints decoded text", file=sys.stderr)
+            return 2
+        # byte-level text loop (pairs with `dataset create-text` defaults):
+        # tokenize here, detokenize the result below
+        from kubeml_tpu.data.text import byte_encode
+
+        prompts = byte_encode(args.text)[None]
+    else:
+        if not args.datafile:
+            print("error: provide --datafile or --text", file=sys.stderr)
+            return 2
+        prompts = np.load(args.datafile, allow_pickle=False)
+    eos_id = args.eos_id
+    if args.text is not None and eos_id is None:
+        from kubeml_tpu.data.text import EOS_ID
+
+        eos_id = EOS_ID  # byte-tokenizer models emit EOS_ID between documents
     if args.stream:
-        # chunked JSON lines: tokens print as they come off the chip
+        # chunked JSON lines: tokens print as they come off the chip. Text
+        # mode decodes INCREMENTALLY (a multi-byte UTF-8 character can
+        # straddle two chunks) and skips the non-token done record.
+        text_decoder = None
+        if args.text is not None:
+            import codecs
+
+            from kubeml_tpu.data.text import BYTE_OFFSET, BYTE_VOCAB
+
+            text_decoder = codecs.getincrementaldecoder("utf-8")("replace")
+        text_done = False
         for rec in _client(args).networks().generate(
                 args.network, prompts, max_new_tokens=args.max_new_tokens,
                 temperature=args.temperature, top_k=args.top_k,
-                eos_id=args.eos_id, seed=args.seed, stream=True):
+                eos_id=eos_id, seed=args.seed, stream=True):
             if "error" in rec:
                 print(f"error: {rec['error']}", file=sys.stderr)
                 return 1
-            _print(rec)
+            if text_decoder is not None:
+                raw = bytearray()
+                for t in rec.get("tokens", ()):
+                    if t < BYTE_OFFSET or t >= BYTE_VOCAB:  # pad/eos/foreign
+                        text_done = True
+                        break
+                    if not text_done:
+                        raw.append(t - BYTE_OFFSET)
+                if raw:
+                    print(text_decoder.decode(bytes(raw)), end="", flush=True)
+            else:
+                _print(rec)
+        if text_decoder is not None:
+            print(text_decoder.decode(b"", final=True))
         return 0
     out = _client(args).networks().generate(
         args.network, prompts, max_new_tokens=args.max_new_tokens,
-        temperature=args.temperature, top_k=args.top_k, eos_id=args.eos_id,
+        temperature=args.temperature, top_k=args.top_k, eos_id=eos_id,
         seed=args.seed)
+    if args.text is not None:
+        from kubeml_tpu.data.text import byte_decode
+
+        print(byte_decode(out["tokens"][0]))
+        return 0
     if args.output:
         np.save(args.output, np.asarray(out["tokens"], np.int32))
         print(f"{args.output}: {np.asarray(out['tokens']).shape} tokens, "
@@ -373,8 +420,12 @@ def build_parser() -> argparse.ArgumentParser:
     g = sub.add_parser("generate",
                        help="sample continuations from a trained causal LM")
     g.add_argument("--network", "-n", required=True, help="job id of the model")
-    g.add_argument("--datafile", required=True,
-                   help=".npy int array [batch, prompt_len] of token ids")
+    gsrc = g.add_mutually_exclusive_group(required=True)
+    gsrc.add_argument("--datafile", default=None,
+                      help=".npy int array [batch, prompt_len] of token ids")
+    gsrc.add_argument("--text", default=None,
+                      help="prompt as TEXT (byte-level tokenizer, pairs with "
+                           "`dataset create-text`; output prints as text)")
     g.add_argument("--max-new-tokens", type=int, default=32)
     g.add_argument("--temperature", type=float, default=0.0,
                    help="0 = greedy; > 0 samples (seeded by --seed)")
