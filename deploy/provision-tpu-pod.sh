@@ -48,10 +48,16 @@ echo "syncing repo to all workers..."
 # the SSH login user BEFORE the unprivileged scp
 gcloud compute tpus tpu-vm ssh "$NAME" --zone "$ZONE" --worker=all \
   --command 'sudo mkdir -p /opt/kubeml-tpu && sudo chown "$USER" /opt/kubeml-tpu'
-gcloud compute tpus tpu-vm scp --recurse "$REPO"/. "$NAME":/opt/kubeml-tpu \
+# ship SOURCE, not history/artifacts (.git + results/ dominate repo bytes)
+STAGE=$(mktemp -d)
+trap 'rm -rf "$STAGE"' EXIT
+tar -C "$REPO" --exclude=.git --exclude=results --exclude='__pycache__' \
+    --exclude='*.pyc' -cf - . | tar -C "$STAGE" -xf -
+gcloud compute tpus tpu-vm scp --recurse "$STAGE"/. "$NAME":/opt/kubeml-tpu \
   --zone "$ZONE" --worker=all
 
 echo "installing the supervised unit on every worker..."
+pids=()
 for i in $(seq 0 $((N - 1))); do
   gcloud compute tpus tpu-vm ssh "$NAME" --zone "$ZONE" --worker="$i" --command "
     sudo mkdir -p $DATA_ROOT &&
@@ -62,10 +68,17 @@ for i in $(seq 0 $((N - 1))); do
     sudo systemctl daemon-reload &&
     sudo systemctl enable --now kubeml-supervised
   " &
+  pids+=($!)
 done
-wait
+# fail LOUDLY if any worker's install failed — a silently missing rank means
+# a jax.distributed group that never forms
+failed=0
+for idx in "${!pids[@]}"; do
+  wait "${pids[$idx]}" || { echo "ERROR: worker $idx install failed" >&2; failed=1; }
+done
+[ "$failed" -eq 0 ] || exit 1
 
-echo "fleet up: controller at http://$HOST0:\${KUBEML_CONTROLLER_PORT:-9090}"
+echo "fleet up: controller at http://$HOST0:${KUBEML_CONTROLLER_PORT:-9090}"
 echo "  submit:   kubeml --url http://$HOST0:9090 train ..."
 echo "  logs:     gcloud compute tpus tpu-vm ssh $NAME --zone $ZONE --worker=0 \\"
 echo "              --command 'journalctl -u kubeml-supervised -f'"

@@ -108,6 +108,9 @@ def cmd_generate(args) -> int:
             print("error: --output applies to token-array mode; --text "
                   "prints decoded text", file=sys.stderr)
             return 2
+        if not args.text:
+            print("error: --text prompt is empty", file=sys.stderr)
+            return 2
         # byte-level text loop (pairs with `dataset create-text` defaults):
         # tokenize here, detokenize the result below
         from kubeml_tpu.data.text import byte_encode
@@ -131,7 +134,8 @@ def cmd_generate(args) -> int:
         if args.text is not None:
             import codecs
 
-            from kubeml_tpu.data.text import BYTE_OFFSET, BYTE_VOCAB
+            from kubeml_tpu.data.text import BYTE_OFFSET, BYTE_VOCAB, EOS_ID
+            from kubeml_tpu.models.gpt import PAD_ID
 
             text_decoder = codecs.getincrementaldecoder("utf-8")("replace")
         text_done = False
@@ -143,12 +147,17 @@ def cmd_generate(args) -> int:
                 print(f"error: {rec['error']}", file=sys.stderr)
                 return 1
             if text_decoder is not None:
+                # byte_decode semantics, incrementally: PAD/EOS ends the
+                # text, out-of-range (foreign-vocab) tokens are SKIPPED —
+                # stream and non-stream must print the same answer
                 raw = bytearray()
                 for t in rec.get("tokens", ()):
-                    if t < BYTE_OFFSET or t >= BYTE_VOCAB:  # pad/eos/foreign
+                    if text_done:
+                        break
+                    if t in (PAD_ID, EOS_ID):
                         text_done = True
                         break
-                    if not text_done:
+                    if BYTE_OFFSET <= t < BYTE_VOCAB:
                         raw.append(t - BYTE_OFFSET)
                 if raw:
                     print(text_decoder.decode(bytes(raw)), end="", flush=True)

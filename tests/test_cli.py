@@ -126,3 +126,20 @@ def test_cli_goal_loss_threads_to_request(monkeypatch):
     req = captured["req"]
     assert req.options.goal_loss == 3.2
     assert req.options.engine == "spmd"
+
+
+def test_generate_text_flags():
+    """--text/--datafile are mutually exclusive and one is required;
+    --output is token-mode-only (checked in cmd_generate)."""
+    import pytest
+
+    from kubeml_tpu.cli import build_parser
+
+    p = build_parser()
+    with pytest.raises(SystemExit):
+        p.parse_args(["generate", "-n", "j", "--datafile", "x.npy",
+                      "--text", "hi"])
+    with pytest.raises(SystemExit):
+        p.parse_args(["generate", "-n", "j"])
+    args = p.parse_args(["generate", "-n", "j", "--text", "hi", "--stream"])
+    assert args.text == "hi" and args.stream
