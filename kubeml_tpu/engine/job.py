@@ -128,8 +128,12 @@ class TrainJob:
         self.stop_event = threading.Event()
         # progress stamp for the PS heartbeat monitor (function guardrails):
         # a job whose user code hangs inside a traced program goes stale here
-        # and is failed by the monitor instead of wedging its thread forever
+        # and is failed by the monitor instead of wedging its thread forever.
+        # heartbeat_cold doubles the monitor's allowance while the first
+        # round's XLA compile runs (minutes on chip — ADVICE r4: a cold
+        # compile must not read as a hang); cleared once the first round lands
         self.heartbeat = time.time()
+        self.heartbeat_cold = True
         self.exit_error: Optional[str] = None
         self._stacked_vars = None
         self._final_variables = None
@@ -434,6 +438,7 @@ class TrainJob:
             if loss is None:  # stop requested during retry backoff
                 break
             self.heartbeat = time.time()  # round dispatched: job is alive
+            self.heartbeat_cold = False   # cold-start compile is behind us
             if not losses:
                 # first round dispatched: background-precompile the next
                 # topology-legal scale-up level while this epoch trains, so an
@@ -592,14 +597,26 @@ class TrainJob:
                       exc_info=True)
 
     def _validate(self, dataset: KubeDataset, handle):
+        # epoch-end validation runs no training rounds: stamp per evaluated
+        # round (the loader is streamed through a stamping generator) so a
+        # sweep longer than the function timeout never reads as a hang — one
+        # hung eval round still trips the monitor
+        self.heartbeat = time.time()
         dataset.set_mode(False)
         loader = validation_loader(
             handle, self.parallelism, self.request.batch_size,
             transform=dataset.transform,
             worker_rows=self.trainer.local_rows(self.parallelism),
         )
+
+        def stamping(rounds):
+            for rb in rounds:
+                self.heartbeat = time.time()
+                yield rb
+
         with self.tracer.span("job.validate", job=self.job_id):
-            acc, loss = self.trainer.evaluate_rounds(self._stacked_vars, loader)
+            acc, loss = self.trainer.evaluate_rounds(self._stacked_vars,
+                                                     stamping(loader))
         dataset.set_mode(True)
         return acc, loss
 
@@ -628,6 +645,7 @@ class TrainJob:
         return self.trainer.reference_variables(self._stacked_vars)
 
     def _save_checkpoint(self, epoch: int) -> None:
+        self.heartbeat = time.time()  # checkpoint phase: no rounds stamping
         try:
             with self.tracer.span("job.checkpoint", job=self.job_id, epoch=epoch):
                 # the device->host copy is synchronous (it must snapshot THIS

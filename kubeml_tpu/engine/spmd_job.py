@@ -114,8 +114,11 @@ class SPMDJob:
 
         self.history = History(id=job_id, task={"request": request.to_dict()})
         self.stop_event = threading.Event()
-        # progress stamp for the PS heartbeat monitor (function guardrails)
+        # progress stamp for the PS heartbeat monitor (function guardrails).
+        # heartbeat_cold doubles the monitor's allowance while the first
+        # step's XLA compile runs (minutes on chip); cleared after it lands
         self.heartbeat = time.time()
+        self.heartbeat_cold = True
         self.exit_error: Optional[str] = None
         self._dataset_handle = None
         # live inference and a donating train step must not touch the same
@@ -214,6 +217,7 @@ class SPMDJob:
                         with self._step_lock:
                             losses.append(self.trainer.train_step(batch, step_rng))
                         self.heartbeat = time.time()
+                        self.heartbeat_cold = False  # first compile is done
                 if not losses:
                     break  # stopped mid-epoch
                 train_loss = float(np.mean([float(l) for l in losses]))
@@ -344,10 +348,15 @@ class SPMDJob:
 
     def _validate(self):
         """Mean (eval loss, next-token accuracy) over the test split."""
+        # validation runs no train steps: stamp per eval batch so a sweep
+        # longer than the function timeout never reads as a hang (a single
+        # eval BATCH hung inside a traced program still trips the monitor)
+        self.heartbeat = time.time()
         losses, accs = [], []
         with self.tracer.span("job.validate", job=self.job_id, engine="spmd"):
             for batch in self._token_batches("test", self.request.batch_size):
                 l, a = self.trainer.eval_metrics(batch)  # enters the mesh itself
+                self.heartbeat = time.time()
                 losses.append(l)
                 accs.append(a)
         if not losses:
@@ -454,6 +463,7 @@ class SPMDJob:
         }
 
     def _save_checkpoint(self, epoch: int) -> None:
+        self.heartbeat = time.time()  # checkpoint phase: no steps stamping
         if self.request.options.sharded_checkpoints:
             self._save_checkpoint_sharded(epoch)
             return

@@ -358,11 +358,20 @@ class ParameterServer:
                         # broadcast timeouts, not this monitor
                         continue
                     stale = time.time() - getattr(job, "heartbeat", time.time())
-                    if stale > timeout:
-                        self._handle_wedged_job(jid, record, stale, timeout)
+                    # double the allowance while the first step's XLA compile
+                    # runs (ADVICE r4: a cold compile can legitimately exceed
+                    # the timeout; scaling with the knob keeps short test
+                    # timeouts meaningful); engines clear the flag after the
+                    # first round/step lands
+                    cold = getattr(job, "heartbeat_cold", False)
+                    allowed = timeout * (2.0 if cold else 1.0)
+                    if stale > allowed:
+                        self._handle_wedged_job(jid, record, stale, timeout,
+                                                allowed)
 
     def _handle_wedged_job(self, job_id: str, record: _JobRecord,
-                           stale: float, timeout: float) -> None:
+                           stale: float, timeout: float,
+                           allowed: float) -> None:
         """Fail a threaded job whose user code stopped making progress: the
         wedged thread is ABANDONED (Python cannot kill it; it leaks until
         process exit — the documented cost of in-process functions), the
@@ -372,11 +381,13 @@ class ParameterServer:
             record.job.stop()  # cooperative; a truly wedged thread ignores it
         except Exception:
             pass
+        extra = (f", cold-start allowance {allowed:g}s"
+                 if allowed != timeout else "")
         handled = self._fail_dead_record(
             job_id, record,
             f"job made no progress for {stale:.0f}s (function execution "
-            f"timeout {timeout:g}s; KUBEML_FUNCTION_TIMEOUT) — user code "
-            f"abandoned")
+            f"timeout {timeout:g}s; KUBEML_FUNCTION_TIMEOUT{extra}) — user "
+            f"code abandoned")
         if handled:
             log.error("job %s: heartbeat stale for %.0fs; thread abandoned "
                       "and job marked failed", job_id, stale)
@@ -818,7 +829,10 @@ class ParameterServer:
         mtime = mtime[2] if mtime else None
         with self._lock:
             cached = self._decoders.get(model_id)
-            if cached is not None and cached[1] == mtime:
+            # a closed decoder (init failed on-device, unrecoverable loop
+            # fault) is dead weight: rebuild instead of 503ing every request
+            if (cached is not None and cached[1] == mtime
+                    and not cached[0].closed):
                 return cached[0]
         from ..serving import BatchingDecoder
 
@@ -830,7 +844,8 @@ class ParameterServer:
             # double-checked: a racing thread may have built one meanwhile —
             # theirs may already carry traffic, ours is guaranteed unused
             current = self._decoders.get(model_id)
-            if current is not None and current[1] == mtime:
+            if (current is not None and current[1] == mtime
+                    and not current[0].closed):
                 stale.append(decoder)
                 decoder = current[0]
             else:

@@ -434,6 +434,13 @@ class BatchingDecoder:
                 return
             yield item
 
+    @property
+    def closed(self) -> bool:
+        """True once the engine is permanently down (explicit ``close`` or an
+        unrecoverable device failure). The PS decoder cache checks this to
+        rebuild instead of returning a decoder that 503s everything."""
+        return self._closed
+
     def close(self) -> None:
         """Hard shutdown: fails everything queued or in flight."""
         with self._cond:
@@ -475,6 +482,13 @@ class BatchingDecoder:
             self._slab = self._init_slab()
         except Exception as e:  # init/compile failure fails all waiters
             log.exception("%s: slab init failed", self.name)
+            with self._cond:
+                # close BEFORE failing the waiters: with the engine thread
+                # gone, later submits would otherwise enqueue into a loop
+                # nobody runs and block the full timeout each. Closed, they
+                # get a fast DecoderClosed 503 and the PS decoder cache
+                # rebuilds a fresh decoder (it skips closed entries).
+                self._closed = True
             self._fail_all(e)
             return
 

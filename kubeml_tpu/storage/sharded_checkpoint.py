@@ -128,10 +128,27 @@ class ShardedCheckpointStore:
                         arr = arr.view(_BITCAST[dt])
                     blobs[_slice_key(path, start)] = arr
 
+        # Re-saving over an existing tag must never tear the PREVIOUS
+        # checkpoint (ADVICE r4): the manifest's presence marks a sharded
+        # checkpoint complete, and replacing shard-<p>.npz files while the
+        # old manifest stays published would let a crash mid-rewrite (or a
+        # concurrent restore) silently assemble a mix of old and new slice
+        # data. Discipline: (1) STAGE every process's new shard under a tmp
+        # name — any failure here leaves the old checkpoint fully
+        # restorable; (2) unpublish the old manifest; (3) rename the staged
+        # shards into place; (4) republish. A crash inside (2)-(4) reads as
+        # "checkpoint absent" (no manifest), never as mixed data — the
+        # multi-file analogue of the flat store's os.replace atomicity.
         shard_path = d / f"shard-{proc}.npz"
         tmp = d / f".shard-{proc}.{uuid.uuid4().hex}.npz"
         try:
             np.savez(tmp, **blobs)
+            if barrier is not None:  # every process has staged its bytes
+                barrier(f"ckpt-staged/{job_id}/{tag}")
+            if proc == 0:
+                (d / MANIFEST).unlink(missing_ok=True)
+            if barrier is not None:  # no shard lands under a live manifest
+                barrier(f"ckpt-clear/{job_id}/{tag}")
             os.replace(tmp, shard_path)
         except Exception:
             tmp.unlink(missing_ok=True)

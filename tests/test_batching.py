@@ -313,3 +313,28 @@ def test_generate_request_prompt_lengths_validation():
         GenerateRequest(prompts=[[1, 2]], prompt_lengths=[3])
     with pytest.raises(ValueError, match="prompt_lengths"):
         GenerateRequest(prompts=[[1, 2]], prompt_lengths=[True])
+
+
+def test_init_failure_closes_decoder(served):
+    """ADVICE r4 (medium): a slab-init failure must CLOSE the decoder, so
+    later submits get a fast DecoderClosed 503 instead of enqueueing into a
+    loop nobody runs (and blocking for the full timeout each)."""
+    import time
+
+    from kubeml_tpu.serving.batcher import DecoderClosed
+
+    m, variables = served
+    dec = BatchingDecoder(m, variables, slots=2, chunk_steps=4)
+    dec._init_slab = lambda: (_ for _ in ()).throw(RuntimeError("device fault"))
+    p = np.arange(1, 6, dtype=np.int32)[None]
+    e = dec.submit(GenerateRequest(prompts=p.tolist(), max_new_tokens=4))
+    with pytest.raises(RuntimeError, match="device fault"):
+        dec.wait(e, timeout=60)
+    deadline = time.time() + 10
+    while not dec.closed and time.time() < deadline:
+        time.sleep(0.05)
+    assert dec.closed
+    t0 = time.time()
+    with pytest.raises(DecoderClosed):
+        dec.submit(GenerateRequest(prompts=p.tolist(), max_new_tokens=4))
+    assert time.time() - t0 < 5.0

@@ -164,3 +164,53 @@ class Model(KubeModel):
     def configure_optimizers(self):
         return optax.sgd(self.lr)
 """
+
+
+def test_monitor_cold_start_allowance(tmp_config):
+    """ADVICE r4: a heartbeat stale during the FIRST step (cold XLA compile,
+    minutes on chip) gets DOUBLE the timeout before abandonment; a steady-
+    state job with the same staleness is failed."""
+    import threading
+    from types import SimpleNamespace
+
+    from kubeml_tpu.api.config import Config, set_config
+    from kubeml_tpu.api.types import JobStateEnum, TrainOptions, TrainRequest, TrainTask
+    from kubeml_tpu.ps.parameter_server import ParameterServer, _JobRecord
+    from kubeml_tpu.storage import HistoryStore
+
+    cfg = Config(data_root=tmp_config.data_root, function_timeout=20.0)
+    set_config(cfg)
+    ps = ParameterServer(history_store=HistoryStore(config=cfg), config=cfg)
+
+    def record(job_id, cold):
+        job = SimpleNamespace(
+            heartbeat=time.time() - 22.0,  # past the timeout, well under 2x
+            # (18s of scheduling slack before the doubled 40s window closes
+            # — this box is 1-core and monitor ticks are 2s apart)
+            heartbeat_cold=cold, dist=None, stop=lambda: None)
+        th = threading.Thread(target=time.sleep, args=(60,), daemon=True)
+        th.start()
+        task = TrainTask(job_id=job_id, parameters=TrainRequest(
+            model_type="custom", batch_size=16, epochs=1, dataset="d",
+            lr=0.01, function_name="f", options=TrainOptions()))
+        task.status = JobStateEnum.RUNNING
+        rec = _JobRecord(task=task, job=job, thread=th)
+        with ps._lock:
+            ps._jobs[job_id] = rec
+        return task, job
+
+    warm_task, _ = record("warmjob", cold=False)
+    cold_task, cold_job = record("coldjob", cold=True)
+    ps._ensure_monitor()
+    deadline = time.time() + 30
+    while time.time() < deadline and warm_task.status != JobStateEnum.FAILED:
+        time.sleep(0.2)
+    # steady-state job at 1.5x timeout: failed. Cold job: still within its
+    # doubled window.
+    assert warm_task.status == JobStateEnum.FAILED
+    assert cold_task.status != JobStateEnum.FAILED
+    # once the cold job's staleness crosses 2x the timeout, it fails too
+    deadline = time.time() + 45
+    while time.time() < deadline and cold_task.status != JobStateEnum.FAILED:
+        time.sleep(0.2)
+    assert cold_task.status == JobStateEnum.FAILED

@@ -182,3 +182,41 @@ class Model(KubeModel):
     def configure_optimizers(self):
         return optax.adamw(self.lr)
 """
+
+
+def test_resave_stages_then_republishes(tmp_path, monkeypatch):
+    """ADVICE r4: re-saving an existing tag must never tear it. A failure
+    while STAGING the new shard leaves the old checkpoint fully restorable;
+    a crash inside the rename window reads as "checkpoint absent" (manifest
+    unpublished), never as a mix of old and new slices."""
+    import kubeml_tpu.storage.sharded_checkpoint as sc
+
+    mesh = make_mesh(dp=4, tp=2)
+    store = ShardedCheckpointStore(root=tmp_path)
+    store.save("jobr", sharded_tree(mesh), epoch=1, tag="latest")
+    assert store.exists("jobr", "latest")
+
+    # (a) failure while staging the new bytes: OLD checkpoint intact
+    monkeypatch.setattr(sc.np, "savez",
+                        lambda *a, **k: (_ for _ in ()).throw(OSError("disk")))
+    with pytest.raises(OSError):
+        store.save("jobr", sharded_tree(mesh), epoch=2, tag="latest")
+    monkeypatch.undo()
+    assert store.exists("jobr", "latest")
+    assert store.read_manifest("jobr", "latest")["epoch"] == 1
+    assert store.restore("jobr", "latest").epoch == 1
+
+    # (b) crash in the rename window (after the manifest unlink): the torn
+    # rewrite is INVISIBLE, not a mixed read
+    monkeypatch.setattr(sc.os, "replace",
+                        lambda *a, **k: (_ for _ in ()).throw(OSError("boom")))
+    with pytest.raises(OSError):
+        store.save("jobr", sharded_tree(mesh), epoch=2, tag="latest")
+    monkeypatch.undo()
+    assert not store.exists("jobr", "latest")
+    assert store.tags("jobr") == []
+
+    # (c) a clean re-save republishes
+    store.save("jobr", sharded_tree(mesh), epoch=2, tag="latest")
+    assert store.exists("jobr", "latest")
+    assert store.read_manifest("jobr", "latest")["epoch"] == 2
