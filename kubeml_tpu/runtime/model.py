@@ -159,3 +159,19 @@ class KubeModel(ABC):
         """Prediction for raw inference payloads; default class ids."""
         logits, _ = self.forward(variables, x, train=False)
         return jnp.argmax(logits, axis=-1)
+
+    def serving_remap(self):
+        """None (default), or a restore-time leaf remap from this model's
+        TRAINING checkpoint layout to its serving layout (the ``remap``
+        contract of ``storage.sharded_checkpoint``: ``stored_path -> None |
+        [(target_path, index_prefix)]``).
+
+        Override when ``build()`` returns a different module shape under a
+        training mesh than for serving — the canonical case is a function
+        whose build() trains ``PipelinedCausalLM`` (stage-STACKED params)
+        when ``self.mesh`` has pp > 1 but serves the flat
+        ``CausalTransformer``; return
+        ``models.gpt_pipeline.flat_serving_remap(stages, layers_per_stage)``
+        there. The platform applies it when loading finished checkpoints for
+        /infer and /generate."""
+        return None

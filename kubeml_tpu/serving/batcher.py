@@ -65,6 +65,36 @@ class DecoderClosed(KubeMLError):
         super().__init__("decoder is shut down", 503)
 
 
+def _param_shardings(module, mesh):
+    """NamedSharding pytree for a causal-LM module's variables, derived from
+    its own ``nn.with_partitioning`` annotations (the same derivation the
+    SPMD trainer uses, parallel/trainer.py): abstract-init the module (no
+    device work) and read the PartitionSpecs off the boxed params."""
+    import flax.linen as nn
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dummy = jnp.zeros((1, 2), jnp.int32)
+    abstract = jax.eval_shape(
+        lambda r: module.init(r, dummy, train=False), jax.random.PRNGKey(0))
+    specs = nn.get_partition_spec(abstract)
+    shapes = jax.tree.map(lambda a: a.shape, nn.meta.unbox(abstract))
+
+    def fit(spec, shape):
+        # an annotated dim falls back to replication FOR THAT AXIS when the
+        # mesh lacks the axis (e.g. a dp-only serving mesh) or the dim does
+        # not divide it (e.g. a tiny test vocab on lm_head); production
+        # meshes name tp and size dims to divide, so this is a no-op there
+        axes = tuple(
+            ax if (ax is None
+                   or (ax in mesh.shape
+                       and shape[i] % int(mesh.shape[ax]) == 0)) else None
+            for i, ax in enumerate(spec))
+        return NamedSharding(mesh, P(*axes))
+
+    return jax.tree.map(fit, specs, shapes,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
 def _sample_rows(logits, keys, temp, topk, active=None):
     """One next-token draw per row with PER-ROW runtime knobs.
 
@@ -177,7 +207,8 @@ class BatchingDecoder:
 
     def __init__(self, module, variables, *, slots: int = 8,
                  chunk_steps: int = 8, bucket_min: int = 16,
-                 pipeline_depth: int = 4, name: str = "decoder"):
+                 pipeline_depth: int = 4, name: str = "decoder",
+                 mesh=None):
         cap = getattr(module, "max_len", None)
         if cap is None:
             raise GenerationInputError(
@@ -188,6 +219,14 @@ class BatchingDecoder:
         self.slots = int(slots)
         self.chunk_steps = int(chunk_steps)
         self.bucket_min = int(bucket_min)
+        # SHARDED serving (VERDICT r4 next-1): with a mesh, params follow the
+        # module's own ``nn.with_partitioning`` annotations (megatron tp) and
+        # the KV slab is head-sharded over ``tp`` — the decode step becomes
+        # one SPMD program over the serving mesh, so a model too big for one
+        # chip serves through the same engine. The sharded-checkpoint store
+        # restores straight onto these shardings (no host ever materializes
+        # a full leaf), closing the train-big-serve-small gap.
+        self.mesh = mesh
         # dispatch pipelining: the device may run up to this many programs
         # ahead of the host's processed state. Chip-measured necessity: each
         # value fetch costs a ~110ms round trip through the dev tunnel, so a
@@ -196,7 +235,14 @@ class BatchingDecoder:
         # never waits for the host.
         self.pipeline_depth = int(pipeline_depth)
         self.name = name
-        self._variables = jax.device_put(variables)
+        if mesh is not None:
+            # params land (or stay) on the serving mesh under the module's
+            # partitioning annotations; already-sharded leaves (a sharded-
+            # checkpoint restore onto this mesh) are left in place
+            self._variables = jax.device_put(
+                variables, _param_shardings(module, mesh))
+        else:
+            self._variables = jax.device_put(variables)
         self._pending: deque = deque()
         self._slot_rows: List[Optional[_Row]] = [None] * self.slots
         self._free = list(range(self.slots))
@@ -220,13 +266,24 @@ class BatchingDecoder:
         tail = min(self.chunk_steps,
                    max(8, (self.chunk_steps // 3 + 7) // 8 * 8))
         self._chunk_sizes = sorted({self.chunk_steps, tail})
+        if mesh is not None:
+            # explicit out_shardings keep the slab sharded through every
+            # link of the dispatch chain (and make donation legal: input and
+            # output layouts match exactly)
+            self._slab_sharding = self._slab_shardings()
+            rep = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+            outs = (self._slab_sharding, rep)
+        else:
+            self._slab_sharding = None
+            outs = None
         self._steps = {
             T: jax.jit(functools.partial(self._step_impl, steps=T),
-                       donate_argnums=donate)
+                       donate_argnums=donate, out_shardings=outs)
             for T in self._chunk_sizes
         }
         self._prefill_admit = jax.jit(self._prefill_admit_impl,
-                                      donate_argnums=donate)
+                                      donate_argnums=donate,
+                                      out_shardings=outs)
 
     # --- device programs ---
 
@@ -332,7 +389,7 @@ class BatchingDecoder:
         packed = jnp.stack([firsts, live0.astype(jnp.int32)], axis=1)  # [k, 2]
         return slab2, packed
 
-    def _init_slab(self) -> _Slab:
+    def _init_slab_impl(self) -> _Slab:
         S = self.slots
         cache = init_cache(self.module, self._variables, S)
         return _Slab(
@@ -346,6 +403,36 @@ class BatchingDecoder:
             jnp.zeros((S,), jnp.int32),
             jnp.full((S,), -1, jnp.int32),
         )
+
+    def _init_slab(self) -> _Slab:
+        if self.mesh is None:
+            return self._init_slab_impl()
+        # sharded serving: the slab is BORN sharded (jit + out_shardings), so
+        # no host or single device ever holds the whole KV cache
+        return jax.jit(self._init_slab_impl,
+                       out_shardings=self._slab_sharding)()
+
+    def _slab_shardings(self):
+        """NamedSharding pytree for the slab: 4-d ``k``/``v`` cache leaves
+        ``[S, max_len, H, D]`` are HEAD-sharded over ``tp`` (axis 2 — heads
+        are what the module's column-sharded qkv projections split, so the
+        per-shard cache lines up with the per-shard attention compute and no
+        collective touches the cache itself); every other leaf (cursors,
+        knobs, per-layer valid masks) is replicated."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        has_tp = "tp" in self.mesh.shape
+        tp = int(self.mesh.shape["tp"]) if has_tp else 1
+        abstract = jax.eval_shape(self._init_slab_impl)
+
+        def leaf_spec(path, s):
+            name = getattr(path[-1], "key", None) if path else None
+            if (has_tp and name in ("k", "v") and getattr(s, "ndim", 0) == 4
+                    and s.shape[2] % tp == 0):
+                return NamedSharding(self.mesh, P(None, None, "tp", None))
+            return NamedSharding(self.mesh, P())
+
+        return jax.tree_util.tree_map_with_path(leaf_spec, abstract)
 
     # --- public API ---
 

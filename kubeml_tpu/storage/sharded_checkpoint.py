@@ -176,6 +176,12 @@ class ShardedCheckpointStore:
     def exists(self, job_id: str, tag: str) -> bool:
         return (self._dir(job_id, tag) / MANIFEST).exists()
 
+    def manifest_path(self, job_id: str, tag: str) -> Path:
+        """The manifest file (the checkpoint's completion marker — its mtime
+        is the PS serving cache's freshness key, like the flat store's
+        export_path)."""
+        return self._dir(job_id, tag) / MANIFEST
+
     def tags(self, job_id: str) -> List[str]:
         jd = self.root / job_id
         if not jd.exists():
@@ -197,40 +203,103 @@ class ShardedCheckpointStore:
         job_id: str,
         tag: str,
         shardings: Optional[Dict[str, Any]] = None,
+        remap: Optional[Callable] = None,
     ) -> ShardedCheckpoint:
         """Rebuild the pytree.
 
-        With ``shardings`` (a pytree of NamedSharding matching the saved
-        tree): leaves come back as jax Arrays on the TARGET mesh, each
-        process reading only the stored slices overlapping its own devices'
-        shards — the stored mesh shape is irrelevant. Without: full numpy
-        leaves (single-host serving/inspection path)."""
+        With ``shardings`` (a pytree of NamedSharding matching the saved —
+        or remapped — tree): leaves come back as jax Arrays on the TARGET
+        mesh, each process reading only the stored slices overlapping its
+        own devices' shards — the stored mesh shape is irrelevant. Without:
+        full numpy leaves (single-host serving/inspection path).
+
+        ``remap`` re-layouts the tree AT RESTORE TIME without materializing
+        the stored layout first: a callable ``stored_path -> None | [(
+        target_path, index_prefix)]``. ``None`` keeps the leaf as-is; a list
+        fans a stored leaf out into target leaves, each the stored leaf
+        indexed by ``index_prefix`` on its leading axes (e.g. a pipeline
+        job's ``params/stages/layer_j`` leaves, STACKED on the ``pp`` axis,
+        become the flat model's per-block ``params/block_i`` leaves — each
+        target reads only the byte ranges of its own stage slice, so serving
+        a pp-trained checkpoint never gathers the stacked tree;
+        models.gpt_pipeline.flat_serving_remap builds this plan)."""
         import jax
 
-        manifest = self.read_manifest(job_id, tag)
         d = self._dir(job_id, tag)
+        mpath = d / MANIFEST
+        if not mpath.exists():
+            raise CheckpointNotFoundError(f"{job_id}/{tag} (sharded)")
+        before = mpath.stat()
+        manifest = json.loads(mpath.read_text())
         readers = _ShardReaders(d)
         flat_specs = manifest["leaves"]
+        # Pin every shard file NOW and verify the manifest is unchanged
+        # after: open handles keep the original inodes alive (POSIX), so a
+        # concurrent re-save that renames new shards over these names cannot
+        # change what this restore reads. A re-save that got in first
+        # unpublishes the manifest before any rename (save() step 2), so an
+        # unchanged manifest after the opens proves the handles are the
+        # manifest's own generation — never a mix of old and new slices.
+        shard_ids = sorted({sl["shard"] for spec in flat_specs.values()
+                            for sl in spec["slices"]})
+        for sid in shard_ids:
+            readers.get(sid)
+        try:
+            after = mpath.stat()
+        except OSError:
+            after = None
+        if (after is None or after.st_ino != before.st_ino
+                or after.st_mtime_ns != before.st_mtime_ns):
+            readers.close()
+            raise StorageError(
+                f"checkpoint {job_id}/{tag} was replaced while a restore was "
+                f"starting; retry the restore")
+        # target plan: path -> (stored path, leading-axis index prefix)
+        plan: Dict[str, Tuple[str, Tuple[int, ...]]] = {}
+        for p in flat_specs:
+            fan = remap(p) if remap is not None else None
+            if fan is None:
+                plan[p] = (p, ())
+            else:
+                for tgt, pre in fan:
+                    plan[tgt] = (p, tuple(int(i) for i in pre))
+
+        def sub_assemble(src, spec, pre, index, out_shape):
+            full = tuple(slice(i, i + 1) for i in pre) + tuple(index)
+            return _assemble(readers, src, spec, full).reshape(out_shape)
+
         try:
             if shardings is None:
-                pairs = {p: _assemble(readers, p, spec, None)
-                         for p, spec in flat_specs.items()}
+                pairs = {}
+                for tgt, (src, pre) in plan.items():
+                    spec = flat_specs[src]
+                    if not pre:
+                        pairs[tgt] = _assemble(readers, src, spec, None)
+                    else:
+                        shape = tuple(spec["shape"])[len(pre):]
+                        idx = tuple(slice(0, s) for s in shape)
+                        pairs[tgt] = sub_assemble(src, spec, pre, idx, shape)
             else:
                 flat_sh = dict(_flatten_any(shardings))
-                missing = set(flat_specs) - set(flat_sh)
+                missing = set(plan) - set(flat_sh)
                 if missing:
                     raise StorageError(
                         f"restore shardings missing leaves: {sorted(missing)[:4]}")
                 pairs = {}
-                for p, spec in flat_specs.items():
-                    target = flat_sh[p]
+                for tgt, (src, pre) in plan.items():
+                    spec = flat_specs[src]
+                    target = flat_sh[tgt]
                     dtype = _stored_dtype(spec["dtype"])
-                    shape = tuple(spec["shape"])
+                    shape = tuple(spec["shape"])[len(pre):]
 
-                    def cb(index, p=p, spec=spec):
-                        return _assemble(readers, p, spec, index)
+                    def cb(index, src=src, spec=spec, pre=pre, shape=shape):
+                        out = tuple(
+                            (s.stop if s.stop is not None else dim)
+                            - (s.start if s.start is not None else 0)
+                            for s, dim in zip(index, shape))
+                        return sub_assemble(src, spec, pre, index, out)
 
-                    pairs[p] = jax.make_array_from_callback(
+                    pairs[tgt] = jax.make_array_from_callback(
                         shape, target, cb, dtype=dtype)
         finally:
             readers.close()
@@ -247,6 +316,26 @@ class ShardedCheckpointStore:
         if not d.exists():
             raise CheckpointNotFoundError(f"{job_id}/{tag} (sharded)")
         shutil.rmtree(d)
+
+
+def apply_remap_host(variables: Dict[str, Any], remap) -> Dict[str, Any]:
+    """Apply a restore-time remap plan (see ``restore``'s ``remap``) to an
+    in-memory host pytree — the FLAT-checkpoint counterpart: a pp-trained
+    job saved through the flat store still re-layouts to its serving shape
+    (stacked stage leaves sliced per target block; small models, host copies
+    are fine here)."""
+    out: Dict[str, Any] = {}
+    for path, leaf in _flatten_any(variables):
+        fan = remap(path)
+        if fan is None:
+            out[path] = leaf
+            continue
+        for tgt, pre in fan:
+            sub = leaf
+            for i in pre:
+                sub = sub[int(i)]
+            out[tgt] = sub
+    return _unflatten(out)
 
 
 # --- internals ---
