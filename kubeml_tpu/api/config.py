@@ -107,16 +107,10 @@ class Config:
 
     def serving_mesh_axes(self) -> dict:
         """Parsed ``serving_mesh`` ({} when disabled); same ``ax=n`` comma
-        syntax as the CLI's ``--mesh``."""
-        spec = self.serving_mesh.strip()
-        if not spec:
-            return {}
-        try:
-            return {ax.strip(): int(size)
-                    for ax, size in (kv.split("=") for kv in spec.split(","))}
-        except ValueError:
-            raise ValueError(
-                f"KUBEML_SERVING_MESH expects e.g. tp=2, got {spec!r}")
+        syntax as the CLI's ``--mesh`` (parallel.mesh.parse_mesh_spec)."""
+        from ..parallel.mesh import parse_mesh_spec
+
+        return parse_mesh_spec(self.serving_mesh)
 
     def job_socket_path(self, job_id: str):
         """Unix-socket path for a standalone job's tensor server. Lives under

@@ -55,13 +55,12 @@ def cmd_train(args) -> int:
     k = -1 if args.sparse_avg else args.k
     mesh_shape = None
     if args.mesh:
+        from .parallel.mesh import parse_mesh_spec
+
         try:
-            mesh_shape = {
-                ax: int(size)
-                for ax, size in (kv.split("=") for kv in args.mesh.split(","))
-            }
-        except ValueError:
-            print("error: --mesh expects e.g. tp=2,sp=2", file=sys.stderr)
+            mesh_shape = parse_mesh_spec(args.mesh) or None
+        except ValueError as e:
+            print(f"error: --mesh {e}", file=sys.stderr)
             return 1
     req = TrainRequest(
         job_id=args.id or "",

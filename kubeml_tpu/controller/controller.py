@@ -142,14 +142,34 @@ class Controller:
     # --- checkpoints (TPU-native: the reference deletes weights at job end and
     # has no model export at all — SURVEY §5) ---
 
+    @property
+    def _sharded_checkpoints(self):
+        store = getattr(self, "_sharded_ckpt_store", None)
+        if store is None:
+            from ..storage.sharded_checkpoint import ShardedCheckpointStore
+
+            store = ShardedCheckpointStore(root=self.checkpoints.root)
+            self._sharded_ckpt_store = store
+        return store
+
     def _ckpt_list_all(self, req: Request):
-        return {j: self.checkpoints.tags(j) for j in self.checkpoints.list_jobs()}
+        out = {j: self.checkpoints.tags(j) for j in self.checkpoints.list_jobs()}
+        for j in self._sharded_checkpoints.list_jobs():
+            tags = out.setdefault(j, [])
+            tags.extend(t for t in self._sharded_checkpoints.tags(j)
+                        if t not in tags)
+        return out
 
     def _ckpt_list(self, req: Request):
         job = req.params["id"]
-        return {"job": job, "checkpoints": self.checkpoints.tags(job)}
+        tags = self.checkpoints.tags(job)
+        tags.extend(t for t in self._sharded_checkpoints.tags(job)
+                    if t not in tags)
+        return {"job": job, "checkpoints": tags}
 
     def _ckpt_export(self, req: Request):
+        from ..api.errors import CheckpointNotFoundError
+
         epoch_s = req.arg("epoch")
         epoch = None
         if epoch_s:
@@ -157,13 +177,61 @@ class Controller:
                 epoch = int(epoch_s)
             except ValueError:
                 raise KubeMLError(f"invalid epoch {epoch_s!r}", 400)
-        path = self.checkpoints.export_path(
-            req.params["id"], epoch=epoch, tag=req.arg("tag")
-        )
+        job = req.params["id"]
+        try:
+            path = self.checkpoints.export_path(job, epoch=epoch,
+                                                tag=req.arg("tag"))
+        except CheckpointNotFoundError:
+            path = self._materialize_sharded_export(job, epoch, req.arg("tag"))
         return Response(path.read_bytes(), content_type="application/octet-stream")
 
+    def _materialize_sharded_export(self, job: str, epoch, tag):
+        """Flat-file export of a SHARDED checkpoint (e.g. a sharded-
+        checkpoints job's gather-free final): assemble the host tree from
+        the slice files and write it through the flat store once, so the
+        download surface keeps working for jobs that never gathered. An
+        explicit export IS the user asking for the whole model, so the host
+        materialization is the point, not a regression."""
+        from ..api.errors import CheckpointNotFoundError
+
+        store = self._sharded_checkpoints
+        if tag is None:
+            if epoch is not None:
+                tag = f"ep{epoch:05d}"
+            else:
+                tags = store.tags(job)
+                from ..storage.checkpoint import FINAL_TAG
+
+                tag = (FINAL_TAG if FINAL_TAG in tags
+                       else (tags[-1] if tags else None))
+        if tag is None or not store.exists(job, tag):
+            raise CheckpointNotFoundError(job)
+        ck = store.restore(job, tag)  # host leaves
+        self.checkpoints.save(job, ck.variables, epoch=ck.epoch, tag=ck.tag,
+                              meta=ck.meta)
+        return self.checkpoints.export_path(job, tag=ck.tag)
+
     def _ckpt_delete(self, req: Request):
-        self.checkpoints.delete(req.params["id"], tag=req.arg("tag"))
+        from ..api.errors import CheckpointNotFoundError
+
+        tag = req.arg("tag")
+        deleted = False
+        try:
+            self.checkpoints.delete(req.params["id"], tag=tag)
+            deleted = True
+        except CheckpointNotFoundError:
+            pass
+        sharded = self._sharded_checkpoints
+        if tag is not None:
+            if sharded.exists(req.params["id"], tag):
+                sharded.delete(req.params["id"], tag)
+                deleted = True
+        else:
+            for t in sharded.tags(req.params["id"]):
+                sharded.delete(req.params["id"], t)
+                deleted = True
+        if not deleted:
+            raise CheckpointNotFoundError(req.params["id"])
         return {"deleted": req.params["id"]}
 
     # --- functions ---

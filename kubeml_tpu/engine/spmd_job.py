@@ -286,20 +286,12 @@ class SPMDJob:
                     # checkpoints ("no host ever materializes a full leaf")
                     # must hold for the model the job LEAVES BEHIND too —
                     # the PS serves it by restoring straight onto a serving
-                    # mesh (VERDICT r4 next-1: trains-big must serve-big)
-                    import flax.linen as nn
-
-                    barrier = (self.dist.barrier
-                               if self.dist is not None and self.dist.size > 1
-                               else None)
-                    self._sharded_store().save(
-                        self.job_id, nn.meta.unbox(self.trainer.params),
-                        epoch=len(self.history.train_loss), tag=FINAL_TAG,
-                        meta={"request": req.to_dict(),
-                              "history": self._history_lists()},
-                        barrier=(lambda tag: barrier(f"{tag}/final"))
-                        if barrier is not None else None,
-                    )
+                    # mesh (VERDICT r4 next-1: trains-big must serve-big).
+                    # FINAL records the completed-epoch count as its epoch
+                    # (the next start index — resume semantics match
+                    # engine/resume.py and _restore_sharded)
+                    self._save_checkpoint_sharded(
+                        len(self.history.train_loss), tag=FINAL_TAG)
                 else:
                     final = self._host_params()  # collective in dist mode
                     if self._leader:
@@ -513,11 +505,13 @@ class SPMDJob:
 
         return ShardedCheckpointStore(root=self.checkpoint_store.root)
 
-    def _save_checkpoint_sharded(self, epoch: int) -> None:
+    def _save_checkpoint_sharded(self, epoch: int,
+                                 tag: Optional[str] = None) -> None:
         """Gather-free checkpoint: every process writes only the leaf slices
         its devices own (storage.sharded_checkpoint). COLLECTIVE in dist mode
         (the pre-manifest barrier); faults are fatal for the same one-sided
-        reasons as the gather above."""
+        reasons as the gather above. ``tag`` defaults to the epoch tag; the
+        end-of-job export passes FINAL_TAG."""
         import flax.linen as nn
 
         with self.tracer.span("job.checkpoint", job=self.job_id, epoch=epoch,
@@ -526,10 +520,10 @@ class SPMDJob:
                        if self.dist is not None and self.dist.size > 1 else None)
             self._sharded_store().save(
                 self.job_id, nn.meta.unbox(self.trainer.params),
-                epoch=epoch, tag=f"ep{epoch:05d}",
+                epoch=epoch, tag=tag or f"ep{epoch:05d}",
                 meta={"request": self.request.to_dict(),
                       "history": self._history_lists()},
-                barrier=(lambda tag: barrier(f"{tag}/{epoch}"))
+                barrier=(lambda t: barrier(f"{t}/{epoch}"))
                 if barrier is not None else None,
             )
 
@@ -546,13 +540,30 @@ class SPMDJob:
         tags = store.tags(self.job_id)
         if not tags:
             return -1
-        tag = tags[-1]
+        # mirror engine/resume.select_resume_checkpoint: an epoch tag epN
+        # resumes at N+1; the FINAL export records its completed-epoch count
+        # (already the next start index). The furthest start wins — naive
+        # tags[-1] would pick 'final' lexicographically and double-advance
+        # the start epoch, silently skipping an epoch of requested training.
+        candidates = []  # (start_epoch, tag)
+        ep_tags = sorted(t for t in tags if t.startswith("ep"))
+        if ep_tags:
+            last = ep_tags[-1]
+            candidates.append(
+                (int(store.read_manifest(self.job_id, last)["epoch"]) + 1,
+                 last))
+        if FINAL_TAG in tags:
+            candidates.append(
+                (int(store.read_manifest(self.job_id, FINAL_TAG)["epoch"]),
+                 FINAL_TAG))
+        if not candidates:
+            return -1
+        start, tag = max(candidates)
         unboxed = meta.unbox(self.trainer.params)
         shardings = jax.tree.map(lambda x: x.sharding, unboxed)
         ck = store.restore(self.job_id, tag, shardings=shardings)
         self.trainer.params = meta.replace_boxed(self.trainer.params, ck.variables)
         extend_history(self.history, ck)
-        start = int(ck.epoch) + 1
         log.info("%s: resumed from sharded checkpoint %s (epoch %d)",
                  self.job_id, tag, start)
         return start

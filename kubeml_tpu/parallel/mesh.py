@@ -14,6 +14,21 @@ from jax.sharding import Mesh
 AXIS_ORDER = ("dp", "pp", "ep", "sp", "tp")
 
 
+def parse_mesh_spec(spec: str) -> Dict[str, int]:
+    """Parse an ``ax=n[,ax=n...]`` mesh spec (the CLI ``--mesh`` and
+    ``KUBEML_SERVING_MESH`` dialect) into an axis-shape dict. Empty/blank
+    input is {} (no mesh). Raises ValueError with the expected syntax on
+    malformed input."""
+    spec = (spec or "").strip()
+    if not spec:
+        return {}
+    try:
+        return {ax.strip(): int(size)
+                for ax, size in (kv.split("=") for kv in spec.split(","))}
+    except ValueError:
+        raise ValueError(f"mesh spec expects e.g. tp=2,sp=2 — got {spec!r}")
+
+
 def mesh_shape_for(n_devices: int, **requested: int) -> Dict[str, int]:
     """Fill in a full axis-shape dict for ``n_devices``: requested axes keep
     their sizes, remaining devices go to ``dp``."""

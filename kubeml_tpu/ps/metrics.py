@@ -26,6 +26,46 @@ GAUGES = {
 }
 RUNNING = "kubeml_job_running_total"
 
+# serving-runtime series (continuous batcher, serving/stats.py): per-model,
+# labeled ``model``. Counters end in _total; the rest are gauges.
+SERVING_COUNTERS = {
+    "kubeml_serving_tokens_total": ("tokens_emitted",
+                                    "Tokens emitted by the decode engine"),
+    "kubeml_serving_requests_submitted_total": (
+        "requests_submitted", "Generate requests accepted into the queue"),
+    "kubeml_serving_requests_completed_total": (
+        "requests_completed", "Generate requests fully served"),
+    "kubeml_serving_requests_rejected_total": (
+        "requests_rejected", "Generate requests rejected at validation"),
+    "kubeml_serving_requests_timeout_total": (
+        "requests_timeout", "Generate requests abandoned on waiter timeout"),
+    "kubeml_serving_requests_canceled_total": (
+        "requests_canceled", "Generate requests explicitly canceled"),
+    "kubeml_serving_requests_failed_total": (
+        "requests_failed", "Generate requests failed by an engine fault"),
+    "kubeml_serving_admission_waves_total": (
+        "admission_waves", "Batched prefill+admit programs dispatched"),
+    "kubeml_serving_chunks_total": ("chunks",
+                                    "Decode chunk programs dispatched"),
+}
+SERVING_GAUGES = {
+    "kubeml_serving_tokens_per_second": (
+        "tokens_per_second", "Sustained decode rate (10s window)"),
+    "kubeml_serving_queue_depth": ("queue_depth",
+                                   "Rows waiting for a decode slot"),
+    "kubeml_serving_slots_busy": ("slots_busy", "Occupied decode slots"),
+    "kubeml_serving_slot_occupancy": ("slot_occupancy",
+                                      "Busy fraction of decode slots"),
+    "kubeml_serving_latency_p50_seconds": (
+        "latency_p50_seconds", "Median request latency (recent window)"),
+    "kubeml_serving_latency_p95_seconds": (
+        "latency_p95_seconds", "p95 request latency (recent window)"),
+    "kubeml_serving_first_token_p50_seconds": (
+        "first_token_p50_seconds", "Median time to first token"),
+    "kubeml_serving_first_token_p95_seconds": (
+        "first_token_p95_seconds", "p95 time to first token"),
+}
+
 
 class MetricsRegistry:
     def __init__(self):
@@ -33,6 +73,12 @@ class MetricsRegistry:
         # {(metric, jobid): value}
         self._values: Dict[Tuple[str, str], float] = {}
         self._running: Dict[str, int] = {"train": 0, "inference": 0}
+        # () -> {model_id: telemetry dict} from the PS's resident decoders
+        # (serving/batcher.telemetry); set by the PS, read at render time
+        self._serving_source = None
+
+    def set_serving_source(self, source) -> None:
+        self._serving_source = source
 
     def update(self, u: MetricUpdate) -> None:
         """Per-epoch push from a job (reference: metrics.go:90-98)."""
@@ -74,7 +120,29 @@ class MetricsRegistry:
             lines.append(f"# TYPE {RUNNING} gauge")
             for kind, n in sorted(self._running.items()):
                 lines.append(f'{RUNNING}{{type="{kind}"}} {n}')
-            return "\n".join(lines) + "\n"
+            source = self._serving_source
+        # serving telemetry OUTSIDE the lock: the source snapshots each
+        # decoder under its own lock and must not nest under ours
+        if source is not None:
+            try:
+                per_model = source()
+            except Exception:
+                per_model = {}
+            for metric, (key, help_text) in SERVING_COUNTERS.items():
+                lines.append(f"# HELP {metric} {help_text}")
+                lines.append(f"# TYPE {metric} counter")
+                for model, snap in sorted(per_model.items()):
+                    if key in snap:
+                        lines.append(
+                            f'{metric}{{model="{model}"}} {snap[key]}')
+            for metric, (key, help_text) in SERVING_GAUGES.items():
+                lines.append(f"# HELP {metric} {help_text}")
+                lines.append(f"# TYPE {metric} gauge")
+                for model, snap in sorted(per_model.items()):
+                    if key in snap:
+                        lines.append(
+                            f'{metric}{{model="{model}"}} {snap[key]}')
+        return "\n".join(lines) + "\n"
 
     def get(self, metric: str, job_id: str) -> float:
         with self._lock:

@@ -180,6 +180,9 @@ class _Entry:
     stream_q: Optional[queue.Queue] = None
     done_evt: threading.Event = field(default_factory=threading.Event)
     error: Optional[Exception] = None
+    submitted_at: float = 0.0   # monotonic; serving telemetry (stats.py)
+    first_token_at: float = 0.0  # 0 until the first token lands
+    aborted: bool = False        # timeout/cancel already counted
 
     def finished(self) -> bool:
         return all(r.done for r in self.rows)
@@ -219,6 +222,11 @@ class BatchingDecoder:
         self.slots = int(slots)
         self.chunk_steps = int(chunk_steps)
         self.bucket_min = int(bucket_min)
+        # serving telemetry: counters/quantiles the PS renders on /metrics
+        # (reference gauge discipline, ml/pkg/ps/metrics.go:33-86)
+        from .stats import DecoderStats
+
+        self.stats = DecoderStats(slots)
         # SHARDED serving (VERDICT r4 next-1): with a mesh, params follow the
         # module's own ``nn.with_partitioning`` annotations (megatron tp) and
         # the KV slab is head-sharded over ``tp`` — the decode step becomes
@@ -236,11 +244,18 @@ class BatchingDecoder:
         self.pipeline_depth = int(pipeline_depth)
         self.name = name
         if mesh is not None:
-            # params land (or stay) on the serving mesh under the module's
-            # partitioning annotations; already-sharded leaves (a sharded-
-            # checkpoint restore onto this mesh) are left in place
-            self._variables = jax.device_put(
-                variables, _param_shardings(module, mesh))
+            # params land on the serving mesh under the module's
+            # partitioning annotations. A sharded-checkpoint restore already
+            # placed every leaf on THIS mesh (the PS derives the same specs
+            # before restoring) — skip the re-derivation (a full abstract
+            # init trace) and the no-op device_put on that hot path.
+            leaves = jax.tree.leaves(variables)
+            placed = leaves and all(
+                isinstance(l, jax.Array)
+                and getattr(l.sharding, "mesh", None) == mesh
+                for l in leaves)
+            self._variables = (variables if placed else jax.device_put(
+                variables, _param_shardings(module, mesh)))
         else:
             self._variables = jax.device_put(variables)
         self._pending: deque = deque()
@@ -438,6 +453,16 @@ class BatchingDecoder:
 
     def submit(self, req) -> _Entry:
         """Validate and enqueue a GenerateRequest; returns its entry."""
+        try:
+            return self._submit(req)
+        except KubeMLError as e:
+            if e.status_code == 400:
+                self.stats.rejected()
+            raise
+
+    def _submit(self, req) -> _Entry:
+        import time as _time
+
         prompts = np.asarray(req.prompts)
         if prompts.ndim != 2 or not np.issubdtype(prompts.dtype, np.integer):
             raise KubeMLError(
@@ -457,7 +482,8 @@ class BatchingDecoder:
                     else None)
         rows = []
         entry = _Entry(rows=rows, max_new=req.max_new_tokens,
-                       stream_q=queue.Queue() if req.stream else None)
+                       stream_q=queue.Queue() if req.stream else None,
+                       submitted_at=_time.monotonic())
         for i in range(B):
             key = (np.asarray(jax.random.fold_in(base_key, i))
                    if base_key is not None
@@ -474,6 +500,7 @@ class BatchingDecoder:
             if self._closed or self._retired:
                 raise DecoderClosed()
             self._pending.extend(rows)
+            self.stats.submitted(1)
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._loop, name=f"decode-{self.name}", daemon=True)
@@ -492,6 +519,9 @@ class BatchingDecoder:
             # nobody will read the result: cancel so the rows stop holding
             # decode slots (they would otherwise run to max_new_tokens and
             # starve live traffic behind discarded work)
+            if not entry.aborted:
+                entry.aborted = True
+                self.stats.timed_out()
             self.cancel(entry)
             raise KubeMLError("generation timed out", 504)
         if entry.error is not None:
@@ -502,6 +532,9 @@ class BatchingDecoder:
         """Abandon a request: queued rows leave the pending queue now;
         admitted rows are evicted from their slots at the next chunk
         boundary."""
+        if not entry.aborted:
+            entry.aborted = True
+            self.stats.canceled()
         with self._cond:
             for row in entry.rows:
                 row.canceled = True
@@ -520,6 +553,19 @@ class BatchingDecoder:
                        "lengths": [len(r.out) for r in entry.rows]}
                 return
             yield item
+
+    def telemetry(self) -> dict:
+        """One snapshot of the decoder's serving metrics: the stats counters
+        plus the live queue-depth and slot-occupancy gauges (engine state —
+        read here so the exposition never touches engine internals)."""
+        snap = self.stats.snapshot()
+        with self._cond:
+            snap["queue_depth"] = float(len(self._pending))
+            busy = sum(1 for r in self._slot_rows if r is not None)
+        snap["slots_busy"] = float(busy)
+        snap["slots_total"] = float(self.slots)
+        snap["slot_occupancy"] = busy / max(self.slots, 1)
+        return snap
 
     @property
     def closed(self) -> bool:
@@ -768,6 +814,7 @@ class BatchingDecoder:
         for slot, row in group:
             self._slot_rows[slot] = row
             self._steps_ahead[slot] = 0
+        self.stats.admitted_wave()
         return ("admit", group, packed)
 
     def _dispatch_chunk(self, needed: int) -> tuple:
@@ -781,6 +828,7 @@ class BatchingDecoder:
         self._slab, packed = self._steps[size](self._variables, self._slab)
         for slot in range(self.slots):
             self._steps_ahead[slot] += size
+        self.stats.chunk()
         return ("chunk", packed, list(self._slot_rows))
 
     def _process_record(self, rec: tuple) -> None:
@@ -844,12 +892,23 @@ class BatchingDecoder:
             self._free.append(slot)
         entry = row.entry
         if entry.finished():
+            if not entry.aborted:
+                import time as _time
+
+                self.stats.completed(_time.monotonic() - entry.submitted_at)
             entry.done_evt.set()
             if entry.stream_q is not None:
                 entry.stream_q.put(None)
 
     def _emit_delta(self, row: _Row, tokens: List[int]) -> None:
-        q = row.entry.stream_q
+        import time as _time
+
+        entry = row.entry
+        if entry.first_token_at == 0.0:
+            entry.first_token_at = _time.monotonic()
+            self.stats.first_token(entry.first_token_at - entry.submitted_at)
+        self.stats.emitted(len(tokens))
+        q = entry.stream_q
         if q is not None:
             q.put({"row": row.index, "tokens": tokens})
 
@@ -859,11 +918,16 @@ class BatchingDecoder:
             self._pending.clear()
             self._slot_rows = [None] * self.slots
             self._free = list(range(self.slots))
+        failed_entries = set()
         for row in rows:
             row.done = True
             entry = row.entry
             if entry.error is None:
                 entry.error = error
+            if id(entry) not in failed_entries and not entry.aborted:
+                failed_entries.add(id(entry))
+                entry.aborted = True
+                self.stats.failed()
             entry.done_evt.set()
             if entry.stream_q is not None:
                 entry.stream_q.put(None)

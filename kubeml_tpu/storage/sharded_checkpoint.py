@@ -192,6 +192,14 @@ class ShardedCheckpointStore:
             if (p / MANIFEST).exists()
         )
 
+    def list_jobs(self) -> List[str]:
+        if not self.root.exists():
+            return []
+        return sorted(
+            d.name for d in self.root.iterdir()
+            if d.is_dir() and any(d.glob(f"*{SHARD_DIR_SUFFIX}/{MANIFEST}"))
+        )
+
     def read_manifest(self, job_id: str, tag: str) -> Dict[str, Any]:
         p = self._dir(job_id, tag) / MANIFEST
         if not p.exists():

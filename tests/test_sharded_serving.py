@@ -325,3 +325,70 @@ def test_restore_detects_concurrent_resave(tmp_path, monkeypatch):
     monkeypatch.undo()
     # the settled checkpoint restores cleanly
     assert store.restore("racer", "final").epoch == 2
+
+
+@pytest.mark.slow
+def test_sharded_resume_not_shadowed_by_final(tmp_config):
+    """Resuming a sharded-checkpoints job whose FINAL export exists must
+    start at the completed-epoch count, not one past it: 'final' sorts
+    after every 'epNNNNN' tag, and the naive newest-tag pick would silently
+    skip an epoch of requested training."""
+    from kubeml_tpu.engine.spmd_job import SPMDJob
+    from kubeml_tpu.functions.registry import FunctionRegistry
+    from kubeml_tpu.storage import CheckpointStore, HistoryStore
+
+    store = _token_store(tmp_config)
+    reg = FunctionRegistry(config=tmp_config)
+    reg.create("lmfn", LM_FN)
+
+    def run(epochs, resume):
+        model = reg.load("lmfn")
+        model._set_params(lr=1e-3, batch_size=16, epoch=0, k=1, task="train")
+        req = TrainRequest(
+            batch_size=16, epochs=epochs, dataset="tokens", lr=1e-3,
+            function_name="lmfn",
+            options=TrainOptions(engine="spmd", precision="f32",
+                                 validate_every=0, checkpoint_every=1,
+                                 sharded_checkpoints=True, resume=resume,
+                                 mesh_shape={"tp": 2}))
+        job = SPMDJob("resum1", req, model, store=store,
+                      history_store=HistoryStore(config=tmp_config),
+                      checkpoint_store=CheckpointStore(config=tmp_config))
+        return job.train()
+
+    h1 = run(epochs=2, resume=False)
+    assert len(h1.train_loss) == 2
+    # resume for one MORE epoch: history extends by exactly one epoch
+    h2 = run(epochs=3, resume=True)
+    assert len(h2.train_loss) == 3
+
+
+@pytest.mark.slow
+def test_controller_exports_sharded_final(tmp_config):
+    """The checkpoint-export endpoint still serves jobs whose final is
+    sharded-only: the controller assembles a flat export from the slice
+    files on demand (and the checkpoint list shows the sharded tags)."""
+    from kubeml_tpu.controller.controller import Controller
+    from kubeml_tpu.storage.checkpoint import CheckpointStore, FINAL_TAG
+
+    store = _token_store(tmp_config)
+    _train(tmp_config, store, LM_FN, "lmfn", "shexp1", mesh_shape={"tp": 2})
+    assert FINAL_TAG not in CheckpointStore(config=tmp_config).tags("shexp1")
+
+    ctl = Controller(None, None, config=tmp_config)
+
+    class FakeReq:
+        params = {"id": "shexp1"}
+
+        @staticmethod
+        def arg(name):
+            return None
+
+    listing = ctl._ckpt_list(FakeReq)
+    assert FINAL_TAG in listing["checkpoints"]
+    rsp = ctl._ckpt_export(FakeReq)
+    # the flat export round-trips through the portable loader
+    out = tmp_config.data_root / "export.npz"
+    out.write_bytes(rsp.body)
+    ck = CheckpointStore.load_export(out)
+    assert "params" in ck.variables
