@@ -99,6 +99,35 @@ def cmd_infer(args) -> int:
     return 0
 
 
+def _model_tokenizer(client, model_id: str):
+    """The tokenizer OBJECT for a model's dataset (trained BPE / vocab
+    asset via the controller), or None for the byte-level fallback. ONLY a
+    404 means byte-level (no history for this id, or a dataset with no
+    tokenizer asset); any other failure raises — silently falling back
+    would encode the prompt with the WRONG vocabulary and print garbage
+    with exit code 0."""
+    from kubeml_tpu.api.errors import KubeMLError
+
+    try:
+        hist = client.histories().get(model_id)
+    except KubeMLError as e:
+        if e.status_code == 404:
+            return None  # no recorded history (live/foreign model)
+        raise
+    dataset = (hist.task or {}).get("request", {}).get("dataset")
+    if not dataset:
+        return None
+    try:
+        spec = client.datasets().tokenizer(dataset)
+    except KubeMLError as e:
+        if e.status_code == 404:
+            return None  # byte-level dataset
+        raise
+    from kubeml_tpu.data.bpe import tokenizer_from_spec
+
+    return tokenizer_from_spec(spec)
+
+
 def cmd_generate(args) -> int:
     import numpy as np
 
@@ -110,11 +139,24 @@ def cmd_generate(args) -> int:
         if not args.text:
             print("error: --text prompt is empty", file=sys.stderr)
             return 2
-        # byte-level text loop (pairs with `dataset create-text` defaults):
-        # tokenize here, detokenize the result below
+        # text loop: resolve the MODEL'S tokenizer (its dataset's trained
+        # BPE / vocab asset via the controller; byte-level fallback) so the
+        # prompt encodes and the output decodes through the same vocabulary
+        # the model trained on
         from kubeml_tpu.data.text import byte_encode
 
-        prompts = byte_encode(args.text)[None]
+        try:
+            tok = _model_tokenizer(_client(args), args.network)
+        except Exception as e:
+            print(f"error: resolving the model's tokenizer failed: {e}",
+                  file=sys.stderr)
+            return 1
+        prompts = (tok.encode(args.text) if tok is not None
+                   else byte_encode(args.text))[None]
+        if prompts.shape[1] == 0:
+            print("error: --text prompt encodes to zero tokens",
+                  file=sys.stderr)
+            return 2
     else:
         if not args.datafile:
             print("error: provide --datafile or --text", file=sys.stderr)
@@ -156,7 +198,11 @@ def cmd_generate(args) -> int:
                     if t in (PAD_ID, EOS_ID):
                         text_done = True
                         break
-                    if BYTE_OFFSET <= t < BYTE_VOCAB:
+                    if tok is not None:
+                        piece = tok.decode_bytes(t)
+                        if piece is not None:
+                            raw.extend(piece)
+                    elif BYTE_OFFSET <= t < BYTE_VOCAB:
                         raw.append(t - BYTE_OFFSET)
                 if raw:
                     print(text_decoder.decode(bytes(raw)), end="", flush=True)
@@ -172,7 +218,8 @@ def cmd_generate(args) -> int:
     if args.text is not None:
         from kubeml_tpu.data.text import byte_decode
 
-        print(byte_decode(out["tokens"][0]))
+        print(tok.decode(out["tokens"][0]) if tok is not None
+              else byte_decode(out["tokens"][0]))
         return 0
     if args.output:
         np.save(args.output, np.asarray(out["tokens"], np.int32))
@@ -199,7 +246,8 @@ def cmd_dataset(args) -> int:
         tokenizer = (json.loads(Path(args.tokenizer).read_text())
                      if args.tokenizer else None)
         _print(c.create_text(args.name, corpus, corpus_test=test,
-                             seq_len=args.seq_len, tokenizer=tokenizer))
+                             seq_len=args.seq_len, tokenizer=tokenizer,
+                             train_bpe=args.train_bpe))
     elif args.action == "delete":
         c.delete(args.name)
         print(f"deleted {args.name}")
@@ -465,6 +513,10 @@ def build_parser() -> argparse.ArgumentParser:
     dt.add_argument("--seq-len", type=int, default=512)
     dt.add_argument("--tokenizer", default=None,
                     help="vocab-JSON tokenizer asset (default: byte-level)")
+    dt.add_argument("--train-bpe", type=int, default=None, metavar="VOCAB",
+                    help="train a byte-level BPE of this vocab size on the "
+                         "corpus at create time (~3-4x fewer tokens than "
+                         "byte-level; stored as the dataset's tokenizer)")
     dd = dsub.add_parser("delete")
     dd.add_argument("--name", "-n", required=True)
     dsub.add_parser("list")

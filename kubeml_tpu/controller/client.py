@@ -118,11 +118,14 @@ class _Datasets:
         )
 
     def create_text(self, name: str, corpus: str, *, corpus_test=None,
-                    seq_len: int = 512, tokenizer: dict = None) -> dict:
+                    seq_len: int = 512, tokenizer: dict = None,
+                    train_bpe: int = None) -> dict:
         """Upload a TEXT corpus: the server tokenizes (byte-level by default,
-        or a vocab-JSON tokenizer asset) and packs [N, seq_len] token rows
-        with EOS separators — the LM engines then train from it like any
-        token dataset. Returns the dataset summary + packing metadata."""
+        a vocab-JSON tokenizer asset, or — with ``train_bpe=N`` — a BPE
+        vocabulary TRAINED on this corpus at create time) and packs
+        [N, seq_len] token rows with EOS separators — the LM engines then
+        train from it like any token dataset. Returns the dataset summary +
+        packing metadata."""
         import json as _json
 
         files = {"corpus": ("corpus.txt", corpus.encode("utf-8")),
@@ -131,9 +134,16 @@ class _Datasets:
             files["corpus-test"] = ("corpus-test.txt", corpus_test.encode("utf-8"))
         if tokenizer is not None:
             files["tokenizer"] = ("tokenizer.json", _json.dumps(tokenizer).encode())
+        if train_bpe is not None:
+            files["train-bpe"] = (None, str(int(train_bpe)))
         return _check(
             requests.post(f"{self.c.url}/dataset/{name}", files=files,
                           timeout=max(self.c.timeout, 300)))
+
+    def tokenizer(self, name: str) -> dict:
+        """The dataset's tokenizer asset (raises 404 for byte-level)."""
+        return _check(requests.get(f"{self.c.url}/dataset/{name}/tokenizer",
+                                   timeout=self.c.timeout))
 
     def get(self, name: str) -> DatasetSummary:
         return DatasetSummary.from_dict(

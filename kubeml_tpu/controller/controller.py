@@ -51,6 +51,7 @@ class Controller:
         router.route("POST", "/generate", self._generate)
         router.route("GET", "/dataset", self._dataset_list)
         router.route("GET", "/dataset/{name}", self._dataset_get)
+        router.route("GET", "/dataset/{name}/tokenizer", self._dataset_tokenizer)
         router.route("POST", "/dataset/{name}", self._dataset_create)
         router.route("DELETE", "/dataset/{name}", self._dataset_delete)
         router.route("GET", "/tasks", self._tasks)
@@ -101,6 +102,18 @@ class Controller:
 
     def _dataset_get(self, req: Request):
         return self.store.get(req.params["name"]).summary().to_dict()
+
+    def _dataset_tokenizer(self, req: Request):
+        """The dataset's tokenizer asset (trained BPE merge table or a
+        user-supplied vocab JSON); 404 when the dataset is byte-tokenized
+        or not a text dataset — callers then use the byte fallback."""
+        handle = self.store.get(req.params["name"])
+        asset = handle.manifest.get("meta", {}).get("tokenizer")
+        if asset is None:
+            raise KubeMLError(
+                f"dataset {req.params['name']!r} has no tokenizer asset "
+                f"(byte-level)", 404)
+        return asset
 
     def _dataset_create(self, req: Request):
         from ..storage.service import create_dataset_from_upload

@@ -73,6 +73,7 @@ class VocabTokenizer:
             self.vocab[tok] = tid
         self.max_len = max(len(t) for t in self.vocab)
         self.vocab_size = max(self.vocab.values()) + 1
+        self._by_id = {tid: tok for tok, tid in self.vocab.items()}
 
     def encode(self, text: str) -> np.ndarray:
         ids: List[int] = []
@@ -90,6 +91,23 @@ class VocabTokenizer:
                     f"tokenizer cannot encode {text[i:i+8]!r} at offset {i} "
                     f"(no vocab entry covers it)", 400)
         return np.asarray(ids, np.int32)
+
+    def decode_bytes(self, token: int):
+        """UTF-8 bytes of one id (None for PAD/EOS/unknown) — the same
+        streaming-decode contract as bpe.BPETokenizer."""
+        tok = self._by_id.get(int(token))
+        return tok.encode("utf-8") if tok is not None else None
+
+    def decode(self, tokens: Sequence[int]) -> str:
+        out = []
+        for t in tokens:
+            t = int(t)
+            if t in (PAD_ID, EOS_ID):
+                break
+            tok = self._by_id.get(t)
+            if tok is not None:
+                out.append(tok)
+        return "".join(out)
 
 
 def split_documents(corpus: str) -> List[str]:
@@ -112,10 +130,16 @@ def pack_corpus(corpus: str, seq_len: int,
     if not docs:
         raise KubeMLError("corpus has no documents (blank-line separated)", 400)
     if tokenizer_spec is not None:
-        tok = VocabTokenizer(tokenizer_spec)
+        if tokenizer_spec.get("kind") == "bpe":
+            from .bpe import BPETokenizer
+
+            tok = BPETokenizer(tokenizer_spec)
+            kind = "bpe"
+        else:
+            tok = VocabTokenizer(tokenizer_spec)
+            kind = "vocab-json"
         encode = tok.encode
         vocab_size = tok.vocab_size
-        kind = "vocab-json"
     else:
         encode = byte_encode
         vocab_size = BYTE_VOCAB

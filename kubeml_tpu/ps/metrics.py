@@ -54,6 +54,7 @@ SERVING_GAUGES = {
     "kubeml_serving_queue_depth": ("queue_depth",
                                    "Rows waiting for a decode slot"),
     "kubeml_serving_slots_busy": ("slots_busy", "Occupied decode slots"),
+    "kubeml_serving_slots_total": ("slots_total", "Configured decode slots"),
     "kubeml_serving_slot_occupancy": ("slot_occupancy",
                                       "Busy fraction of decode slots"),
     "kubeml_serving_latency_p50_seconds": (

@@ -38,6 +38,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -461,8 +462,6 @@ class BatchingDecoder:
             raise
 
     def _submit(self, req) -> _Entry:
-        import time as _time
-
         prompts = np.asarray(req.prompts)
         if prompts.ndim != 2 or not np.issubdtype(prompts.dtype, np.integer):
             raise KubeMLError(
@@ -483,7 +482,7 @@ class BatchingDecoder:
         rows = []
         entry = _Entry(rows=rows, max_new=req.max_new_tokens,
                        stream_q=queue.Queue() if req.stream else None,
-                       submitted_at=_time.monotonic())
+                       submitted_at=time.monotonic())
         for i in range(B):
             key = (np.asarray(jax.random.fold_in(base_key, i))
                    if base_key is not None
@@ -519,8 +518,7 @@ class BatchingDecoder:
             # nobody will read the result: cancel so the rows stop holding
             # decode slots (they would otherwise run to max_new_tokens and
             # starve live traffic behind discarded work)
-            if not entry.aborted:
-                entry.aborted = True
+            if self._record_outcome(entry):
                 self.stats.timed_out()
             self.cancel(entry)
             raise KubeMLError("generation timed out", 504)
@@ -532,8 +530,7 @@ class BatchingDecoder:
         """Abandon a request: queued rows leave the pending queue now;
         admitted rows are evicted from their slots at the next chunk
         boundary."""
-        if not entry.aborted:
-            entry.aborted = True
+        if self._record_outcome(entry):
             self.stats.canceled()
         with self._cond:
             for row in entry.rows:
@@ -553,6 +550,18 @@ class BatchingDecoder:
                        "lengths": [len(r.out) for r in entry.rows]}
                 return
             yield item
+
+    def _record_outcome(self, entry: _Entry) -> bool:
+        """Atomically claim an entry's single telemetry outcome: each
+        request counts exactly one of completed/timeout/canceled/failed.
+        The waiter's timeout and the engine's completion can race on the
+        same entry — the flag flips under the engine lock so only one side
+        wins (the counters must never sum past requests_submitted)."""
+        with self._cond:
+            if entry.aborted:
+                return False
+            entry.aborted = True
+            return True
 
     def telemetry(self) -> dict:
         """One snapshot of the decoder's serving metrics: the stats counters
@@ -892,20 +901,16 @@ class BatchingDecoder:
             self._free.append(slot)
         entry = row.entry
         if entry.finished():
-            if not entry.aborted:
-                import time as _time
-
-                self.stats.completed(_time.monotonic() - entry.submitted_at)
+            if self._record_outcome(entry):
+                self.stats.completed(time.monotonic() - entry.submitted_at)
             entry.done_evt.set()
             if entry.stream_q is not None:
                 entry.stream_q.put(None)
 
     def _emit_delta(self, row: _Row, tokens: List[int]) -> None:
-        import time as _time
-
         entry = row.entry
         if entry.first_token_at == 0.0:
-            entry.first_token_at = _time.monotonic()
+            entry.first_token_at = time.monotonic()
             self.stats.first_token(entry.first_token_at - entry.submitted_at)
         self.stats.emitted(len(tokens))
         q = entry.stream_q
@@ -924,10 +929,10 @@ class BatchingDecoder:
             entry = row.entry
             if entry.error is None:
                 entry.error = error
-            if id(entry) not in failed_entries and not entry.aborted:
+            if id(entry) not in failed_entries:
                 failed_entries.add(id(entry))
-                entry.aborted = True
-                self.stats.failed()
+                if self._record_outcome(entry):
+                    self.stats.failed()
             entry.done_evt.set()
             if entry.stream_q is not None:
                 entry.stream_q.put(None)

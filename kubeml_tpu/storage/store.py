@@ -119,6 +119,7 @@ class ShardStore:
         y_train: np.ndarray,
         x_test: np.ndarray,
         y_test: np.ndarray,
+        meta: Optional[dict] = None,
     ) -> DatasetSummary:
         """Ingest a dataset (the split/insert of reference storage api.py:105-142)."""
         if self.exists(name):
@@ -159,6 +160,10 @@ class ShardStore:
                 "name": name,
                 "subset_size": STORAGE_SUBSET_SIZE,
                 "created_at": time.time(),
+                # extra dataset metadata (e.g. the text path's packing info
+                # + trained tokenizer asset, storage/service.py) — persisted
+                # so the serving/CLI text loop can round-trip the vocabulary
+                **({"meta": meta} if meta else {}),
                 "splits": {
                     split: {
                         "samples": len(x),

@@ -152,3 +152,113 @@ class Model(KubeModel):
     def configure_optimizers(self):
         return optax.adamw(self.lr)
 """
+
+
+# --- trained BPE (round 5, VERDICT r4 weak-5) ---
+
+
+def test_bpe_train_encode_decode_roundtrip():
+    """A trained BPE round-trips text losslessly and packs it to
+    meaningfully fewer tokens than the byte fallback."""
+    from kubeml_tpu.data.bpe import BPETokenizer, train_bpe
+    from kubeml_tpu.data.text import byte_encode
+
+    corpus = "\n\n".join(
+        "the quick brown fox jumps over the lazy dog again and again"
+        for _ in range(50))
+    spec = train_bpe(corpus, vocab_size=512)
+    assert spec["kind"] == "bpe"
+    assert 258 < spec["vocab_size"] <= 512
+    tok = BPETokenizer(spec)
+    sample = "the quick brown fox jumps over the lazy dog"
+    ids = tok.encode(sample)
+    assert tok.decode(ids.tolist()) == sample
+    # compression: repeated words collapse to merged units
+    assert len(ids) < len(byte_encode(sample)) / 2
+    # unseen text still encodes (byte fallback inside the id space)
+    weird = "zxqj éé"
+    assert tok.decode(tok.encode(weird).tolist()) == weird
+
+
+def test_bpe_training_deterministic():
+    from kubeml_tpu.data.bpe import train_bpe
+
+    corpus = "abc abd abe abc abd abc" * 20
+    assert train_bpe(corpus, 300) == train_bpe(corpus, 300)
+
+
+def test_pack_corpus_with_bpe_spec():
+    from kubeml_tpu.data.bpe import train_bpe
+    from kubeml_tpu.data.text import pack_corpus
+
+    corpus = "\n\n".join("hello world this is document %d" % i
+                         for i in range(30))
+    rows_b, meta_b = pack_corpus(corpus, 16)
+    spec = train_bpe(corpus, 1024)
+    rows_s, meta_s = pack_corpus(corpus, 16, spec)
+    assert meta_s["tokenizer"] == "bpe"
+    assert meta_s["vocab_size"] == spec["vocab_size"]
+    # the whole point: same corpus, several-fold fewer tokens
+    assert meta_s["tokens"] < meta_b["tokens"] / 2
+
+
+def test_train_bpe_upload_persists_tokenizer(tmp_config):
+    """create-text with train-bpe trains the vocab server-side, packs with
+    it, and persists the asset in the dataset manifest; the controller
+    serves it back (and 404s for byte-level datasets)."""
+    import requests
+
+    from kubeml_tpu.data.bpe import tokenizer_from_spec
+    from kubeml_tpu.storage.service import StorageService
+    from kubeml_tpu.storage.store import ShardStore
+
+    svc = StorageService(config=tmp_config).start()
+    try:
+        corpus = "\n\n".join(
+            "a longer document %d about the framework serving tokens" % i
+            for i in range(60))
+        files = {"corpus": ("c.txt", corpus.encode()),
+                 "seq-len": (None, "16"), "train-bpe": (None, "1024")}
+        r = requests.post(f"{svc.url}/dataset/bpeset", files=files, timeout=120)
+        assert r.ok, r.text
+        assert r.json()["packing"]["tokenizer"] == "bpe"
+        handle = ShardStore(config=tmp_config).get("bpeset")
+        asset = handle.manifest["meta"]["tokenizer"]
+        assert asset["kind"] == "bpe" and asset["merges"]
+        tok = tokenizer_from_spec(asset)
+        assert tok.decode(tok.encode("the framework").tolist()) == "the framework"
+        # mutually exclusive with a supplied asset
+        bad = requests.post(
+            f"{svc.url}/dataset/bad2",
+            files={"corpus": ("c.txt", corpus.encode()),
+                   "train-bpe": (None, "1024"),
+                   "tokenizer": ("t.json", b'{"tokens": {"a": 5}}')},
+            timeout=60)
+        assert bad.status_code == 400
+    finally:
+        svc.stop()
+
+    from kubeml_tpu.controller.controller import Controller
+
+    ctl = Controller(None, None, config=tmp_config)
+
+    class Req:
+        def __init__(self, name):
+            self.params = {"name": name}
+
+        @staticmethod
+        def arg(name):
+            return None
+
+    asset = ctl._dataset_tokenizer(Req("bpeset"))
+    assert asset["kind"] == "bpe"
+    # a byte-level dataset has no asset -> 404 (callers fall back to bytes)
+    from kubeml_tpu.storage.store import ShardStore as _SS
+
+    _SS(config=tmp_config).create(
+        "byteset", np.arange(64, dtype=np.int32).reshape(4, 16),
+        np.zeros(4, np.int64), np.arange(32, dtype=np.int32).reshape(2, 16),
+        np.zeros(2, np.int64))
+    with pytest.raises(KubeMLError) as e:
+        ctl._dataset_tokenizer(Req("byteset"))
+    assert e.value.status_code == 404
