@@ -98,6 +98,11 @@ class Config:
     # decode steps per device program: larger amortizes dispatch overhead,
     # smaller tightens admission latency for newly arriving requests
     serving_chunk_steps: int = field(default_factory=lambda: _env_int("KUBEML_SERVING_CHUNK", 16))
+    # weight-only int8 decode ("int8"; empty = off): halves the per-step
+    # weight HBM traffic the decode loop is bound on (serving/quant.py).
+    # Single-device serving only (ignored when a serving mesh is set).
+    serving_quantize: str = field(
+        default_factory=lambda: os.environ.get("KUBEML_SERVING_QUANTIZE", ""))
     # SHARDED serving: axis spec like "tp=2" — finished (sharded) checkpoints
     # restore straight onto this mesh and the batcher runs one SPMD decode
     # program over it, so a model too big for one chip still serves. Empty

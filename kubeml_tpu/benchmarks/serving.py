@@ -56,7 +56,8 @@ def one_shot_rate(batch: int, new_tokens: int = NEW_TOKENS, reps: int = 3) -> fl
 
 
 def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
-             new_tokens: int = NEW_TOKENS, stagger: float = 0.0) -> dict:
+             new_tokens: int = NEW_TOKENS, stagger: float = 0.0,
+             quantize: str = "") -> dict:
     """N HTTP clients against a live cluster serving a final checkpoint."""
     import os
     import socket
@@ -77,7 +78,7 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
 
     cfg = Config(controller_port=fp(), scheduler_port=fp(), ps_port=fp(),
                  storage_port=fp(), serving_slots=slots,
-                 serving_chunk_steps=chunk_steps)
+                 serving_chunk_steps=chunk_steps, serving_quantize=quantize)
     cfg.ensure_dirs()
     set_config(cfg)
 
@@ -189,6 +190,8 @@ def main(argv=None) -> int:
     p.add_argument("--new-tokens", type=int, default=NEW_TOKENS)
     p.add_argument("--stagger", type=float, default=0.0,
                    help="spread client starts over this many seconds")
+    p.add_argument("--quantize", default="",
+                   help="serving weight quantization ('' or 'int8')")
     p.add_argument("--skip-comparator", action="store_true")
     args = p.parse_args(argv)
     # the dev chip is SHARED: its deliverable rate swings 2-7x between
@@ -197,7 +200,10 @@ def main(argv=None) -> int:
     # against their mean so the fraction compares same-regime measurements.
     ref_before = None if args.skip_comparator else one_shot_rate(args.slots, args.new_tokens)
     row = run_load(args.clients, args.seconds, args.slots, args.chunk_steps,
-                   new_tokens=args.new_tokens, stagger=args.stagger)
+                   new_tokens=args.new_tokens, stagger=args.stagger,
+                   quantize=args.quantize)
+    if args.quantize:
+        row["quantize"] = args.quantize
     if not args.skip_comparator:
         ref_after = one_shot_rate(args.slots, args.new_tokens)
         ref = (ref_before + ref_after) / 2

@@ -67,10 +67,13 @@ def init_cache(module, variables, batch: int) -> dict:
     device work happens and the dummy token is never written anywhere."""
     dummy = jnp.zeros((batch, 1), jnp.int32)
 
-    def shape_fn():
-        return module.apply(variables, dummy, decode=True, mutable=["cache"])
+    def shape_fn(vs):
+        return module.apply(vs, dummy, decode=True, mutable=["cache"])
 
-    _, vars_out = jax.eval_shape(shape_fn)
+    # variables go through eval_shape AS AN ARGUMENT (not a closure) so
+    # callers may pass an abstract ShapeDtypeStruct tree — the quantized
+    # decode path sizes its cache without materializing dense weights
+    _, vars_out = jax.eval_shape(shape_fn, variables)
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                         vars_out["cache"])
 

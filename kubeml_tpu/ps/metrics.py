@@ -55,6 +55,8 @@ SERVING_GAUGES = {
                                    "Rows waiting for a decode slot"),
     "kubeml_serving_slots_busy": ("slots_busy", "Occupied decode slots"),
     "kubeml_serving_slots_total": ("slots_total", "Configured decode slots"),
+    "kubeml_serving_weight_bytes": (
+        "weight_bytes", "Weight bytes read per decode step (int8 halves it)"),
     "kubeml_serving_slot_occupancy": ("slot_occupancy",
                                       "Busy fraction of decode slots"),
     "kubeml_serving_latency_p50_seconds": (

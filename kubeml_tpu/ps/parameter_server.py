@@ -842,10 +842,19 @@ class ParameterServer:
                 return cached[0]
         from ..serving import BatchingDecoder
 
+        quantize = self.cfg.serving_quantize
+        if quantize not in ("", "int8"):
+            log.warning("KUBEML_SERVING_QUANTIZE=%r not recognized "
+                        "(valid: int8) — serving unquantized", quantize)
+            quantize = ""
+        if quantize and mesh is not None:
+            log.warning("KUBEML_SERVING_QUANTIZE=%s ignored: int8 does not "
+                        "compose with the serving mesh yet", quantize)
+            quantize = ""
         decoder = BatchingDecoder(
             module, variables, slots=self.cfg.serving_slots,
             chunk_steps=self.cfg.serving_chunk_steps, name=model_id,
-            mesh=mesh)
+            mesh=mesh, quantize=quantize)
         stale = []
         with self._lock:
             # double-checked: a racing thread may have built one meanwhile —

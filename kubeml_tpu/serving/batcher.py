@@ -212,7 +212,7 @@ class BatchingDecoder:
     def __init__(self, module, variables, *, slots: int = 8,
                  chunk_steps: int = 8, bucket_min: int = 16,
                  pipeline_depth: int = 4, name: str = "decoder",
-                 mesh=None):
+                 mesh=None, quantize: str = ""):
         cap = getattr(module, "max_len", None)
         if cap is None:
             raise GenerationInputError(
@@ -244,6 +244,22 @@ class BatchingDecoder:
         # never waits for the host.
         self.pipeline_depth = int(pipeline_depth)
         self.name = name
+        # weight-only int8 (serving/quant.py): halves the per-step weight
+        # HBM traffic decode is bound on; the dequantize is traced inside
+        # the scan body (_apply_step) so each step reads int8, not a
+        # materialized bf16 copy. Single-device path (the small-batch case
+        # the bandwidth argument targets).
+        if quantize not in ("", "int8"):
+            raise ValueError(f"unknown quantize mode {quantize!r} "
+                             f"(valid: '', 'int8')")
+        if quantize == "int8" and mesh is not None:
+            raise ValueError("int8 serving does not compose with a serving "
+                             "mesh yet; unset one of them")
+        self.quantize = quantize
+        if quantize == "int8":
+            from .quant import quantize_tree
+
+            variables = quantize_tree(variables)
         if mesh is not None:
             # params land on the serving mesh under the module's
             # partitioning annotations. A sharded-checkpoint restore already
@@ -259,6 +275,11 @@ class BatchingDecoder:
                 variables, _param_shardings(module, mesh)))
         else:
             self._variables = jax.device_put(variables)
+        # per-step weight HBM bytes (the bandwidth accounting the int8 win
+        # is measured against; exported on /metrics)
+        from .quant import quantized_bytes
+
+        self.weight_bytes = quantized_bytes(self._variables)
         self._pending: deque = deque()
         self._slot_rows: List[Optional[_Row]] = [None] * self.slots
         self._free = list(range(self.slots))
@@ -304,10 +325,21 @@ class BatchingDecoder:
     # --- device programs ---
 
     def _apply_step(self, variables, cache, tok, pos):
+        variables = self._dense_vars(variables)
         logits, vs = self.module.apply(
             {**variables, "cache": cache}, tok[:, None], decode=True,
             positions=pos, mutable=["cache"])
         return logits[:, -1].astype(jnp.float32), vs["cache"]
+
+    def _dense_vars(self, variables):
+        """Densify int8 weights INSIDE the traced program (per scan step —
+        the HBM read stays int8 and the convert+scale fuses toward the
+        matmul); identity when not quantized."""
+        if self.quantize != "int8":
+            return variables
+        from .quant import dequantize_tree
+
+        return dequantize_tree(variables, dtype=jnp.float32)
 
     def _step_impl(self, variables, slab, steps=None):
         """Advance every slot ``steps`` tokens (one program per size in
@@ -354,6 +386,7 @@ class BatchingDecoder:
         repeating its last row (same slot, same key, same knobs), so the
         duplicate writes are byte-identical and scatter order can't matter."""
         k, Lb = prompts.shape
+        variables = self._dense_vars(variables)
         cache_k = init_cache(self.module, variables, k)
         logits, vs = self.module.apply(
             {**variables, "cache": cache_k}, prompts, decode=True,
@@ -407,7 +440,10 @@ class BatchingDecoder:
 
     def _init_slab_impl(self) -> _Slab:
         S = self.slots
-        cache = init_cache(self.module, self._variables, S)
+        # shape-only: densify abstractly so quantized trees never
+        # materialize a dense copy just to size the cache
+        dense_abstract = jax.eval_shape(self._dense_vars, self._variables)
+        cache = init_cache(self.module, dense_abstract, S)
         return _Slab(
             cache,
             jnp.zeros((S,), jnp.int32),
@@ -574,6 +610,7 @@ class BatchingDecoder:
         snap["slots_busy"] = float(busy)
         snap["slots_total"] = float(self.slots)
         snap["slot_occupancy"] = busy / max(self.slots, 1)
+        snap["weight_bytes"] = float(self.weight_bytes)
         return snap
 
     @property

@@ -1,0 +1,147 @@
+"""Weight-only int8 quantization for the decode path.
+
+Decode is HBM-bound on the per-step WEIGHT reads (every generated token
+re-reads the whole model; serving/batcher.py's design rests on this —
+batch is nearly free because the weight traffic dominates). int8 weights
+with per-output-channel scales halve that traffic vs bf16 (4x vs f32), so
+small-batch decode throughput should approach 2x; the dequantize runs
+INSIDE the step program (int8 leaves the HBM, the convert+scale happens
+on-chip next to the matmul, where decode has FLOPs to spare).
+
+Scheme: symmetric per-output-channel int8 —
+
+    scale[c] = max(|W[..., c]|) / 127        (last axis = output channel)
+    Q = round(W / scale),  W~ = Q * scale    (bf16/f32 accumulation)
+
+Only floating-point matrices with >= ``min_size`` elements quantize
+(embeddings, attention/MLP kernels, lm_head); biases, LayerNorm scales,
+and small vectors stay exact — they are a rounding error of the byte
+traffic and disproportionately sensitive. Quantized leaves live in the
+variables tree as :class:`QuantizedTensor` pytree nodes, so the SAME tree
+flows through jit/device_put unchanged and ``dequantize_tree`` (traced
+into the decode program) restores a dense tree for ``module.apply``.
+
+The reference has no quantization (or serving runtime) to compare; this
+extends the HBM-bound analysis the round-4 engine is built on
+(VERDICT r4 next-2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# don't quantize small leaves: no bandwidth to win, outsized quality cost
+MIN_QUANT_SIZE = 4096
+
+
+class QuantizedTensor(NamedTuple):
+    """int8 values + per-output-channel f32 scales (a pytree node, so it
+    travels through jit/device_put like any leaf pair)."""
+
+    q: Any  # int8, same shape as the original weight
+    s: Any  # f32, shape [..., 1 x (ndim-1), channels] broadcast over q
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+
+def _quantize_leaf(w) -> QuantizedTensor:
+    w = jnp.asarray(w)
+    absmax = jnp.max(jnp.abs(w), axis=tuple(range(w.ndim - 1)), keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(q=q, s=scale.astype(jnp.float32))
+
+
+def _wants_quant(leaf) -> bool:
+    return (hasattr(leaf, "dtype") and hasattr(leaf, "ndim")
+            and jnp.issubdtype(leaf.dtype, jnp.floating)
+            and leaf.ndim >= 2
+            and int(leaf.size) >= MIN_QUANT_SIZE)
+
+
+def _gather_accessed(path) -> bool:
+    """Embedding-family leaves (token_embed/pos_embed/...): decode GATHERS
+    one row per token instead of streaming the table, so quantizing them
+    saves no per-step bandwidth and only costs quality — they stay exact,
+    and the byte accounting excludes them."""
+    return any("embed" in str(getattr(k, "key", k)).lower() for k in path)
+
+
+def quantize_tree(variables: dict) -> dict:
+    """Quantize every eligible weight leaf of a variables pytree (host or
+    device); returns the same structure with QuantizedTensor nodes.
+    Embedding tables are left exact (gather-accessed — see
+    ``_gather_accessed``)."""
+    import flax.linen as nn
+
+    unboxed = nn.meta.unbox(variables)
+
+    def one(path, leaf):
+        if not _gather_accessed(path) and _wants_quant(leaf):
+            return _quantize_leaf(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(one, unboxed)
+
+
+def _is_q(x) -> bool:
+    return isinstance(x, QuantizedTensor)
+
+
+def dequantize_tree(variables: dict, dtype=jnp.bfloat16) -> dict:
+    """Densify a quantized tree — TRACE THIS INSIDE the step program so the
+    HBM read is int8 and the convert+scale fuses into the consumer (outside
+    jit it would just materialize bf16 copies and forfeit the win)."""
+
+    def one(leaf):
+        if _is_q(leaf):
+            return (leaf.q.astype(dtype) * leaf.s.astype(dtype))
+        return leaf
+
+    return jax.tree.map(one, variables, is_leaf=_is_q)
+
+
+def quality_report(module, variables, tokens) -> dict:
+    """Teacher-forced quality delta of int8 weights on a token batch: the
+    bound the serving knob is published with (VERDICT r4 next-2 'bounded
+    quality delta'). Returns max-abs and relative-L2 logits error plus
+    top-1 (greedy next-token) agreement between full and int8 weights."""
+    import flax.linen as nn
+
+    dense = nn.meta.unbox(variables)
+    tokens = jnp.asarray(tokens, jnp.int32)
+    ref = module.apply(dense, tokens, train=False).astype(jnp.float32)
+    qd = dequantize_tree(quantize_tree(variables), jnp.float32)
+    quant = module.apply(qd, tokens, train=False).astype(jnp.float32)
+    diff = jnp.abs(ref - quant)
+    agree = jnp.mean(
+        (jnp.argmax(ref, -1) == jnp.argmax(quant, -1)).astype(jnp.float32))
+    return {
+        "max_abs_err": float(jnp.max(diff)),
+        "rel_l2_err": float(jnp.linalg.norm(diff.ravel())
+                            / jnp.maximum(jnp.linalg.norm(ref.ravel()), 1e-9)),
+        "top1_agreement": float(agree),
+    }
+
+
+def quantized_bytes(variables: dict) -> int:
+    """Weight bytes the decode step STREAMS per token with this tree (the
+    HBM-traffic accounting the speedup claim rests on). Embedding tables
+    are excluded — decode gathers one row per table per token, so their
+    full size never transits per step in either mode."""
+    total = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+            variables, is_leaf=_is_q):
+        if _gather_accessed(path):
+            continue
+        if _is_q(leaf):
+            total += leaf.q.size * 1 + leaf.s.size * 4
+        elif hasattr(leaf, "size"):
+            total += leaf.size * np.dtype(leaf.dtype).itemsize
+    return total

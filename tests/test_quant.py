@@ -1,0 +1,167 @@
+"""Weight-only int8 decode (serving/quant.py, VERDICT r4 next-2).
+
+Correctness bar: int8 decode through the batcher is TOKEN-IDENTICAL to
+one-shot decode with the dequantized weights (same numbers, one engine vs
+the other), the quantization error itself is bounded and reported, and the
+HBM accounting shows the ~2x byte cut the throughput claim rests on."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubeml_tpu.api.types import GenerateRequest
+from kubeml_tpu.models.generation import generate
+from kubeml_tpu.models.gpt import CausalTransformer
+from kubeml_tpu.serving.batcher import BatchingDecoder
+from kubeml_tpu.serving.quant import (
+    QuantizedTensor, dequantize_tree, quality_report, quantize_tree,
+    quantized_bytes)
+
+VOCAB = 101
+
+
+def tiny():
+    return CausalTransformer(vocab_size=VOCAB, max_len=64, embed_dim=64,
+                             depth=2, num_heads=4)
+
+
+@pytest.fixture(scope="module")
+def served():
+    m = tiny()
+    variables = m.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    return m, variables
+
+
+def test_quantize_roundtrip_error_bounded(served):
+    _, variables = served
+    q = quantize_tree(variables)
+    d = dequantize_tree(q, jnp.float32)
+    import flax.linen as nn
+
+    flat_ref = jax.tree.leaves(nn.meta.unbox(variables))
+    flat_q = jax.tree.leaves(d)
+    for a, b in zip(flat_ref, flat_q):
+        a, b = np.asarray(a), np.asarray(b)
+        if a.size >= 4096 and a.ndim >= 2:
+            # per-channel symmetric int8: worst-case error is scale/2
+            per_ch = np.max(np.abs(a), axis=tuple(range(a.ndim - 1)),
+                            keepdims=True) / 127.0
+            assert np.all(np.abs(a - b) <= per_ch / 2 + 1e-6)
+        else:
+            np.testing.assert_array_equal(a, b)  # small leaves stay exact
+
+
+def test_small_leaves_not_quantized(served):
+    _, variables = served
+    q = quantize_tree(variables)
+    # LayerNorm scales/biases stay plain arrays
+    ln = q["params"]["ln_f"]["scale"]
+    assert not isinstance(ln, QuantizedTensor)
+    # a big kernel is quantized to int8
+    k = q["params"]["block_0"]["mlp_in"]["kernel"]
+    assert isinstance(k, QuantizedTensor) and k.q.dtype == jnp.int8
+
+
+def test_quantized_bytes_halved(served):
+    _, variables = served
+    dense = quantized_bytes(variables)
+    quant = quantized_bytes(quantize_tree(variables))
+    # f32 -> int8(+scales) is ~4x on the big leaves; whole-tree at least 2x
+    assert quant < dense / 2
+
+
+def test_int8_decoder_matches_oneshot_on_dequantized_weights(served):
+    """The engine adds NO error beyond quantization itself: int8 batched
+    decode == one-shot greedy decode run on the dequantized tree."""
+    m, variables = served
+    qd = dequantize_tree(quantize_tree(variables), jnp.float32)
+    dec = BatchingDecoder(m, variables, slots=3, chunk_steps=4,
+                          quantize="int8")
+    try:
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(1, VOCAB, size=(1, int(l))).astype(np.int32)
+                   for l in (4, 7, 9)]
+        refs = [np.asarray(generate(m, qd, p, max_new_tokens=8).tokens)
+                for p in prompts]
+        entries = [dec.submit(GenerateRequest(prompts=p.tolist(),
+                                              max_new_tokens=8))
+                   for p in prompts]
+        for e, ref in zip(entries, refs):
+            assert dec.wait(e, timeout=300)["tokens"][0] == ref[0].tolist()
+        assert dec.weight_bytes < quantized_bytes(variables) / 2
+    finally:
+        dec.close()
+
+
+def test_quality_report_bounds(served):
+    m, variables = served
+    rng = np.random.default_rng(0)
+    toks = rng.integers(1, VOCAB, size=(4, 16)).astype(np.int32)
+    rep = quality_report(m, variables, toks)
+    assert rep["rel_l2_err"] < 0.05
+    assert rep["top1_agreement"] > 0.9
+    assert rep["max_abs_err"] < 1.0
+
+
+def test_int8_rejects_mesh(served):
+    from kubeml_tpu.parallel.mesh import make_mesh
+
+    m, variables = served
+    mesh = make_mesh(shape={"tp": 2}, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="compose"):
+        BatchingDecoder(m, variables, mesh=mesh, quantize="int8")
+
+
+def test_ps_quantize_knob(tmp_config):
+    """KUBEML_SERVING_QUANTIZE=int8 routes finished-model /generate through
+    an int8 decoder (and the telemetry shows the byte cut)."""
+    from kubeml_tpu.api.config import Config
+    from kubeml_tpu.api.types import TrainOptions, TrainRequest, TrainTask
+    from kubeml_tpu.functions.registry import FunctionRegistry
+    from kubeml_tpu.ps.parameter_server import ParameterServer
+    from kubeml_tpu.storage import ShardStore
+
+    store = ShardStore(config=tmp_config)
+    r = np.random.default_rng(0)
+    x = r.integers(1, 64, size=(128, 16)).astype(np.int32)
+    store.create("tokens", x, np.zeros(128, np.int64),
+                 x[:32], np.zeros(32, np.int64))
+    reg = FunctionRegistry(config=tmp_config)
+    reg.create("lmfn", LM_FN)
+    cfg = Config(data_root=tmp_config.data_root, serving_quantize="int8")
+    ps = ParameterServer(registry=reg, store=store, config=cfg)
+    req = TrainRequest(batch_size=16, epochs=1, dataset="tokens", lr=1e-3,
+                       function_name="lmfn",
+                       options=TrainOptions(engine="spmd", precision="f32",
+                                            validate_every=0))
+    ps.start_task(TrainTask(job_id="qjob", parameters=req))
+    assert ps.wait("qjob", timeout=400)
+    out = ps.generate("qjob", GenerateRequest(prompts=[[1, 2, 3]],
+                                              max_new_tokens=6))
+    assert len(out["tokens"][0]) == 6
+    dec = ps._decoders["qjob"][0]
+    assert dec.quantize == "int8"
+    assert 'kubeml_serving_weight_bytes{model="qjob"}' in ps.metrics.render()
+
+
+LM_FN = """
+import optax
+from kubeml_tpu.runtime.model import KubeModel
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.models.gpt import CausalTransformer
+
+class Tokens(KubeDataset):
+    def __init__(self):
+        super().__init__("tokens")
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(Tokens())
+    def build(self):
+        return CausalTransformer(vocab_size=64, max_len=16, embed_dim=32,
+                                 depth=2, num_heads=4, mesh=self.mesh)
+    def configure_optimizers(self):
+        return optax.adamw(self.lr)
+"""
