@@ -357,8 +357,12 @@ class ParameterServer:
                         # multi-host jobs serialize on the dist lock (a
                         # queued job's heartbeat legitimately goes stale) and
                         # an abandoned leader thread would poison that lock
-                        # anyway — dist guardrails are the start-ack and
-                        # broadcast timeouts, not this monitor
+                        # anyway — their stall guardrail is the per-process
+                        # watchdog armed in _run_job_dist/run_follower
+                        # (utils.watchdog.arm_stall_watchdog: a wedged rank
+                        # self-terminates, the group fails fast, supervision
+                        # restarts + journal resumes), plus the start-ack
+                        # and broadcast timeouts
                         continue
                     stale = time.time() - getattr(job, "heartbeat", time.time())
                     # double the allowance while the first step's XLA compile
@@ -496,7 +500,31 @@ class ParameterServer:
                 # _run_job's finally)
                 self._finish(task.job_id, expect=record)
                 return
-            self._run_job(task, job, record)
+            # stall guardrail for the DIST job (the heartbeat monitor skips
+            # dist jobs — abandoning this thread would poison the dist lock
+            # and leave peers inside half-joined collectives): a wedge
+            # terminates this process, the coordination service fatals the
+            # group, supervision restarts it, the journal resumes the job
+            from ..utils.watchdog import arm_stall_watchdog
+
+            def on_stall(reason: str) -> None:
+                if record is not None:
+                    record.keep_journal = True
+                self._ensure_failure_history(task.job_id, task.parameters,
+                                             reason)
+
+            # re-stamp NOW: the heartbeat was set at job construction, and
+            # this thread may have queued on the dist lock behind a long job
+            # for arbitrarily long — arming against the stale stamp would
+            # kill a job seconds after it finally starts
+            job.heartbeat = time.time()
+            guard = arm_stall_watchdog(
+                job, self.cfg.function_timeout,
+                f"dist job {task.job_id} (leader)", on_stall=on_stall)
+            try:
+                self._run_job(task, job, record)
+            finally:
+                guard.set()
 
     def stop_running_jobs(self) -> None:
         """Cooperative stop for every threaded job (multi-host shutdown must

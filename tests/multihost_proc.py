@@ -301,6 +301,59 @@ def _run_chaos_mode(cluster, result) -> None:
     )
 
 
+def _run_stall_mode(cluster, result) -> None:
+    """VERDICT r4 weak-6: a user train step that WEDGES inside the traced
+    module on a dist job. Every process traces the same module, so every
+    process hangs; the stall watchdog must terminate this process (exit 74)
+    after the doubled cold allowance, writing the failure history first.
+    This function never returns normally."""
+    import numpy as np
+
+    from kubeml_tpu.api.types import JobState, TrainOptions, TrainRequest, TrainTask
+
+    src = (
+        "import time\n"
+        "import flax.linen as nn\n"
+        "import optax\n"
+        "from kubeml_tpu.data.dataset import KubeDataset\n"
+        "from kubeml_tpu.runtime.model import KubeModel\n"
+        "class Hang(nn.Module):\n"
+        "    @nn.compact\n"
+        "    def __call__(self, x, train=False):\n"
+        "        time.sleep(3600)  # the wedge: pure-Python hang at trace time\n"
+        "        return nn.Dense(4)(x.reshape((x.shape[0], -1)))\n"
+        "class DS(KubeDataset):\n"
+        "    def __init__(self):\n"
+        "        super().__init__('blobs')\n"
+        "class Model(KubeModel):\n"
+        "    def __init__(self):\n"
+        "        super().__init__(DS())\n"
+        "    def build(self):\n"
+        "        return Hang()\n"
+        "    def configure_optimizers(self):\n"
+        "        return optax.sgd(self.lr)\n"
+        "def main():\n"
+        "    return Model()\n"
+    )
+    cluster.registry.create("hangfn", src)
+    r = np.random.default_rng(0)
+    x = r.normal(size=(64, 8, 8, 1)).astype("float32")
+    y = r.integers(0, 4, 64).astype("int64")
+    cluster.store.create("blobs", x, y, x[:16], y[:16])
+    req = TrainRequest(
+        dataset="blobs", function_name="hangfn", epochs=1, batch_size=16,
+        lr=0.01,
+        options=TrainOptions(default_parallelism=2, k=1, validate_every=0,
+                             static_parallelism=True),
+    )
+    task = TrainTask(job_id="stall001", parameters=req,
+                     state=JobState(parallelism=2))
+    cluster.ps.start_task(task)
+    # never completes: the watchdog exits this process (74) mid-wait
+    cluster.ps.wait(task.job_id, timeout=600)
+    result.update(status=str(task.status), error="watchdog did not fire")
+
+
 def main() -> int:
     rank = int(sys.argv[1])
     nprocs = int(sys.argv[2])
@@ -314,6 +367,10 @@ def main() -> int:
     # mid-training + parallelism-rounding history note
     mode = sys.argv[5] if len(sys.argv) > 5 else "shared"
     out_path = os.path.join(workdir, f"result_{rank}.json")
+    if mode == "stall":
+        # short guardrail window so the stall test runs in seconds (read by
+        # Config at construction below; cold allowance doubles it)
+        os.environ["KUBEML_FUNCTION_TIMEOUT"] = "10"
 
     import jax
 
@@ -367,6 +424,9 @@ def main() -> int:
                 raise _Done
             if mode == "sharded_ckpt":
                 _run_sharded_ckpt_mode(cluster, result)
+                raise _Done
+            if mode == "stall":
+                _run_stall_mode(cluster, result)
                 raise _Done
             # deploy the function + synthetic dataset (both hosts read the
             # same data root, as a shared filesystem would provide)
