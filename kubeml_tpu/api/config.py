@@ -37,7 +37,9 @@ class Config:
     )
 
     # --- service ports (reference cluster DNS const.go:4-14 -> local ports) ---
-    host: str = "127.0.0.1"
+    # bind/connect address for the four services; 0.0.0.0 exposes them to
+    # remote clients (the containerized single-host mode, deploy/docker)
+    host: str = field(default_factory=lambda: os.environ.get("KUBEML_HOST", "127.0.0.1"))
     controller_port: int = field(default_factory=lambda: _env_int("KUBEML_CONTROLLER_PORT", 9090))
     scheduler_port: int = field(default_factory=lambda: _env_int("KUBEML_SCHEDULER_PORT", 9091))
     ps_port: int = field(default_factory=lambda: _env_int("KUBEML_PS_PORT", 9092))

@@ -1,0 +1,185 @@
+"""Host resource sampler — the experiment-side utilization timeline.
+
+The reference records CPU/GPU/memory during experiments via a sidecar Flask
+sampler (reference: ml/experiments/common/metrics.py, prov/usage.py). The
+TPU rebuild's counterpart is in-process and file-based: a background thread
+samples /proc/stat (whole-host CPU), /proc/meminfo, this process's RSS, and
+— when a TPU backend is live — jax's per-device memory stats, appending one
+JSON line per tick. The benchmark harness wraps runs in
+:class:`ResourceSampler` (benchmarks/scenarios.py), and any command can be
+profiled standalone:
+
+    python -m kubeml_tpu.benchmarks.sampler --out usage.jsonl -- \
+        python -m kubeml_tpu.benchmarks.quant_bench
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+
+def _cpu_ticks():
+    """(busy, total) jiffies from /proc/stat's aggregate cpu line."""
+    with open("/proc/stat") as f:
+        parts = f.readline().split()
+    vals = [int(v) for v in parts[1:]]
+    idle = vals[3] + (vals[4] if len(vals) > 4 else 0)  # idle + iowait
+    total = sum(vals)
+    return total - idle, total
+
+
+def _meminfo():
+    out = {}
+    with open("/proc/meminfo") as f:
+        for line in f:
+            k, _, rest = line.partition(":")
+            if k in ("MemTotal", "MemAvailable"):
+                out[k] = int(rest.split()[0]) * 1024
+    return out
+
+
+def _rss(pid: Optional[int] = None):
+    """RSS of ``pid`` (default: this process); None once the pid is gone."""
+    path = f"/proc/{pid}/status" if pid else "/proc/self/status"
+    try:
+        with open(path) as f:
+            for line in f:
+                if line.startswith("VmRSS"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        return None
+    return None
+
+
+def _device_memory():
+    """Per-device memory stats when the backend exposes them (TPU does;
+    CPU returns None) — list of {device, bytes_in_use, bytes_limit}."""
+    try:
+        import jax
+
+        out = []
+        for d in jax.local_devices():
+            stats = getattr(d, "memory_stats", None)
+            if stats is None:
+                return None
+            s = stats()
+            if not s:
+                return None
+            out.append({
+                "device": str(d),
+                "bytes_in_use": int(s.get("bytes_in_use", 0)),
+                "bytes_limit": int(s.get("bytes_limit", 0)),
+            })
+        return out or None
+    except Exception:
+        return None
+
+
+class ResourceSampler:
+    """Append host/device utilization samples to a JSONL file while active.
+
+    Context manager::
+
+        with ResourceSampler("results/usage.jsonl", interval=1.0, tag="run1"):
+            run_benchmark()
+    """
+
+    def __init__(self, out: Path, interval: float = 1.0,
+                 tag: str = "", devices: bool = True,
+                 pid: Optional[int] = None):
+        self.out = Path(out)
+        self.interval = float(interval)
+        self.tag = tag
+        self.devices = devices
+        # whose RSS the timeline records: the profiled CHILD in CLI wrap
+        # mode (sampling the idle wrapper would be meaningless), self here
+        self.pid = pid
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _loop(self) -> None:
+        self.out.parent.mkdir(parents=True, exist_ok=True)
+        prev = _cpu_ticks()
+        t0 = time.time()
+        with self.out.open("a") as f:
+            while not self._stop.wait(self.interval):
+                busy, total = _cpu_ticks()
+                d_busy, d_total = busy - prev[0], total - prev[1]
+                prev = (busy, total)
+                mem = _meminfo()
+                row = {
+                    "t": round(time.time() - t0, 2),
+                    "tag": self.tag,
+                    "cpu_util": round(d_busy / d_total, 4) if d_total else 0.0,
+                    "mem_used_frac": round(
+                        1 - mem.get("MemAvailable", 0)
+                        / max(mem.get("MemTotal", 1), 1), 4),
+                    "rss_bytes": _rss(self.pid),
+                }
+                if self.devices:
+                    dm = _device_memory()
+                    if dm is not None:
+                        row["device_memory"] = dm
+                f.write(json.dumps(row) + "\n")
+                f.flush()
+
+    def start(self) -> "ResourceSampler":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="resource-sampler",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval + 5)
+            self._thread = None
+
+    def __enter__(self) -> "ResourceSampler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def main(argv=None) -> int:
+    import argparse
+    import subprocess
+    import sys
+
+    p = argparse.ArgumentParser(
+        description="sample host/device utilization while a command runs")
+    p.add_argument("--out", default="usage.jsonl")
+    p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--tag", default="")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="command to run (after --); without one, samples "
+                        "until interrupted")
+    args = p.parse_args(argv)
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":  # only the LEADING separator is ours
+        cmd = cmd[1:]
+    if cmd:
+        proc = subprocess.Popen(cmd)
+        with ResourceSampler(Path(args.out), interval=args.interval,
+                             tag=args.tag, devices=False, pid=proc.pid):
+            return proc.wait()
+    with ResourceSampler(Path(args.out), interval=args.interval,
+                         tag=args.tag):
+        try:
+            while True:
+                time.sleep(60)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -527,10 +527,22 @@ def main(argv=None) -> int:
     p.add_argument("--max-parallelism", type=int, default=None,
                    help="cap elastic growth (default: unbounded in full mode, "
                         "4 in --quick)")
+    p.add_argument("--usage-out", default=None,
+                   help="sample host/device utilization to this JSONL while "
+                        "the scenarios run (benchmarks/sampler.py — the "
+                        "reference's experiment-side CPU/mem sidecar)")
     args = p.parse_args(argv)
     try:
-        results = run_all(quick=args.quick, names=args.only,
-                          max_parallelism=args.max_parallelism)
+        import contextlib
+
+        ctx = contextlib.nullcontext()
+        if args.usage_out:
+            from .sampler import ResourceSampler
+
+            ctx = ResourceSampler(args.usage_out, tag="scenarios")
+        with ctx:
+            results = run_all(quick=args.quick, names=args.only,
+                              max_parallelism=args.max_parallelism)
     except ValueError as e:
         print(f"error: {e}", file=__import__("sys").stderr)
         return 2

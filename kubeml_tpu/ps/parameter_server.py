@@ -34,6 +34,7 @@ from ..functions.registry import FunctionRegistry
 from ..storage.checkpoint import FINAL_TAG, CheckpointStore
 from ..storage.history import HistoryStore
 from ..storage.store import ShardStore
+from ..utils.errorhook import report_error
 from .metrics import MetricsRegistry
 
 log = logging.getLogger("kubeml.ps")
@@ -194,9 +195,15 @@ class ParameterServer:
             log.exception("journaling job %s failed (non-fatal)", task.job_id)
         return placeholder
 
-    def _ensure_failure_history(self, job_id: str, request, error: str) -> None:
+    def _ensure_failure_history(self, job_id: str, request, error: str,
+                                sync_report: bool = False) -> None:
         """Guarantee a History record exists for a dead job (completion pollers
-        key off it); keeps any record the job itself managed to save."""
+        key off it); keeps any record the job itself managed to save. Also
+        fires the optional error webhook (utils.errorhook — the reference's
+        Sentry-hook counterpart, no-op unless KUBEML_ERROR_WEBHOOK is set);
+        ``sync_report`` delivers it before returning — the stall watchdog
+        os._exits right after this, which would kill an async thread."""
+        report_error("job-failure", error, wait=sync_report, job_id=job_id)
         try:
             self.history_store.get(job_id)
         except Exception:
@@ -220,6 +227,7 @@ class ParameterServer:
             self._journal.clear(task.job_id)
         except Exception:
             pass
+        report_error("job-start-failure", str(error), job_id=task.job_id)
         self.history_store.save(History(
             id=task.job_id,
             task={"request": task.parameters.to_dict(), "error": str(error)},
@@ -511,7 +519,7 @@ class ParameterServer:
                 if record is not None:
                     record.keep_journal = True
                 self._ensure_failure_history(task.job_id, task.parameters,
-                                             reason)
+                                             reason, sync_report=True)
 
             # re-stamp NOW: the heartbeat was set at job construction, and
             # this thread may have queued on the dist lock behind a long job
@@ -563,6 +571,15 @@ class ParameterServer:
         except Exception as e:
             task.status = JobStateEnum.FAILED
             log.error("job %s failed: %s", task.job_id, e)
+            # an abandoned thread waking with an exception after the monitor
+            # already failed (and reported) this job must not page twice —
+            # same staleness guard as _finish's expect
+            current = True
+            if record is not None:
+                with self._lock:
+                    current = self._jobs.get(task.job_id) is record
+            if current:
+                report_error("job-failure", str(e), job_id=task.job_id)
             from ..engine.failures import is_transient_accelerator_error
 
             if record is not None and is_transient_accelerator_error(e):

@@ -1,0 +1,49 @@
+"""Optional error-reporting hook (the Sentry-integration counterpart).
+
+The reference's env server reports unhandled exceptions to Sentry when
+SENTRY_DSN is set (reference: ml/environment/server.py:15-25). Egress-free
+equivalent: when ``KUBEML_ERROR_WEBHOOK`` is set, job failures POST a small
+JSON record to it (any collector — a Slack webhook, an alertmanager
+receiver, a log sink). Unset (the default), this module is a no-op; the
+hook itself never raises and never blocks a failure path (fire-and-forget
+on a daemon thread with a short timeout).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+
+log = logging.getLogger("kubeml.errorhook")
+
+
+def report_error(context: str, message: str, wait: bool = False,
+                 **fields) -> None:
+    """POST {context, error, ...fields} to KUBEML_ERROR_WEBHOOK (no-op when
+    unset). Never raises. Fire-and-forget by default; ``wait=True`` blocks
+    (bounded by the request timeout) — REQUIRED on paths that are about to
+    ``os._exit`` (the stall watchdog), where a daemon thread would die with
+    the process before the alert leaves it."""
+    url = os.environ.get("KUBEML_ERROR_WEBHOOK", "")
+    if not url:
+        return
+    payload = {"source": "kubeml-tpu", "context": context,
+               "error": str(message), **fields}
+
+    def post():
+        try:
+            import urllib.request
+
+            req = urllib.request.Request(
+                url, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=10).read()
+        except Exception:
+            log.debug("error webhook delivery failed", exc_info=True)
+
+    if wait:
+        post()
+        return
+    threading.Thread(target=post, name="error-webhook", daemon=True).start()
