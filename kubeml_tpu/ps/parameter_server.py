@@ -314,11 +314,19 @@ class ParameterServer:
 
     def _handle_runner_death(self, job_id: str, record: _JobRecord) -> bool:
         """Cleanup after a runner died without its /finish callback (crash,
-        OOM-kill). Returns whether this call performed the teardown."""
-        handled = self._fail_dead_record(
-            job_id, record,
-            f"job runner exited with code {record.proc.returncode}",
-        )
+        OOM-kill, or the runner's own stall watchdog recycling a wedged
+        device — exit 74). Returns whether this call performed the
+        teardown."""
+        from ..utils.watchdog import STALL_EXIT_CODE
+
+        rc = record.proc.returncode
+        if rc == STALL_EXIT_CODE:
+            msg = (f"job runner stalled (no progress within "
+                   f"KUBEML_FUNCTION_TIMEOUT) and recycled itself (exit "
+                   f"{rc}) — the accelerator was released with the process")
+        else:
+            msg = f"job runner exited with code {rc}"
+        handled = self._fail_dead_record(job_id, record, msg)
         if handled:
             log.error("standalone job %s runner exited (code %s) without "
                       "reporting; marked failed", job_id, record.proc.returncode)

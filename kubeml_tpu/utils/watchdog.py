@@ -47,12 +47,17 @@ STALL_EXIT_CODE = 74
 
 
 def arm_stall_watchdog(job, timeout: float, what: str,
-                       on_stall: Optional[Callable[[str], None]] = None):
+                       on_stall: Optional[Callable[[str], None]] = None,
+                       recovery: str = ("supervision restarts it and the "
+                                        "journal resumes the job")):
     """Watch ``job.heartbeat`` from a daemon thread; if it stalls longer
     than ``timeout`` (doubled while ``job.heartbeat_cold`` — the first
     step's XLA compile), run ``on_stall(reason)`` (e.g. write the failure
     history) and ``os._exit(STALL_EXIT_CODE)``. Returns a ``threading.Event``
-    — set it to disarm. ``timeout <= 0`` disables (returns a set event)."""
+    — set it to disarm. ``timeout <= 0`` disables (returns a set event).
+    ``recovery`` names what happens next in the logged reason — callers
+    whose recovery differs (the standalone runner: the job is marked FAILED,
+    not resumed) must say so, not inherit the dist text."""
     stop = threading.Event()
     if timeout is None or timeout <= 0:
         stop.set()
@@ -72,8 +77,7 @@ def arm_stall_watchdog(job, timeout: float, what: str,
                 reason = (
                     f"{what}: no progress for {stale:.0f}s (allowance "
                     f"{allowed:g}s; KUBEML_FUNCTION_TIMEOUT) — terminating "
-                    f"this process so the group fails fast; supervision "
-                    f"restarts it and the journal resumes the job")
+                    f"this process; {recovery}")
                 log.error("%s", reason)
                 if on_stall is not None:
                     try:

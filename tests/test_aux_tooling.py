@@ -132,8 +132,12 @@ def test_kubeml_host_env(monkeypatch):
 
     monkeypatch.setenv("KUBEML_HOST", "0.0.0.0")
     cfg = Config()
-    assert cfg.host == "0.0.0.0"
-    assert cfg.controller_url.startswith("http://0.0.0.0:")
+    assert cfg.host == "0.0.0.0"  # services BIND wide ...
+    # ... but clients dial a real address (0.0.0.0 is not dialable)
+    assert cfg.controller_url.startswith("http://127.0.0.1:")
+    monkeypatch.setenv("KUBEML_HOST", "10.0.0.5")
+    cfg2 = Config()
+    assert cfg2.controller_url.startswith("http://10.0.0.5:")
 
 
 def test_docker_assets_reference_real_paths():

