@@ -144,11 +144,14 @@ def main():
     jax.devices()
     print("DEVICES_OK", flush=True)
 
-    # f32 model dtype: XLA:TPU's default conv/matmul precision already runs f32
-    # operands through the MXU's bf16 passes, so explicit bf16 compute only adds
-    # cast traffic at this model size. The models' `dtype=bfloat16` knob remains
-    # the HBM lever for large transformers.
-    fs = flagship()
+    # bf16 model dtype (round 5): the round-4 f32 assumption ("XLA runs f32
+    # through the MXU's bf16 passes anyway") was WRONG — the compiled round's
+    # convolutions carried f32 operands (multi-pass MXU decomposition;
+    # results/RESNET_MFU_R5.md). Casting compute to bf16 (params stay f32)
+    # lifted the same round 34->44% MFU same-regime on chip.
+    import jax.numpy as jnp
+
+    fs = flagship(dtype=jnp.bfloat16)
     # uint8-staged input pipeline: images cross host->HBM quantized (4x fewer
     # bytes than f32) and dequantize on device (KubeModel.preprocess) — the
     # realistic pipeline for image datasets, which ARE uint8 at rest

@@ -101,10 +101,27 @@ class Config:
     # smaller tightens admission latency for newly arriving requests
     serving_chunk_steps: int = field(default_factory=lambda: _env_int("KUBEML_SERVING_CHUNK", 16))
     # weight-only int8 decode ("int8"; empty = off): halves the per-step
-    # weight HBM traffic the decode loop is bound on (serving/quant.py).
-    # Single-device serving only (ignored when a serving mesh is set).
+    # weight HBM traffic and the weight footprint (serving/quant.py;
+    # chip-measured +4-11% decode at batch 1 for 124M-774M classes,
+    # ~neutral at batch >= 8 — results/QUANT_R5_NOTE.md). Single-device
+    # serving only (ignored when a serving mesh is set).
     serving_quantize: str = field(
         default_factory=lambda: os.environ.get("KUBEML_SERVING_QUANTIZE", ""))
+    # dispatch-chain depth: decode programs the device may run ahead of the
+    # host's processed state. Must be >= serving_fetchers to saturate the
+    # fetch pool; deeper delays completion detection (dead rows burn steps
+    # on long requests). 6/6 is the chip-measured balance
+    # (results/SERVING_R5_NOTE.md).
+    serving_pipeline: int = field(
+        default_factory=lambda: _env_int("KUBEML_SERVING_PIPELINE", 6))
+    # concurrent result-fetch threads (each fetch pays the host<->device
+    # round trip; short-request workloads are fetch-pipeline-bound)
+    serving_fetchers: int = field(
+        default_factory=lambda: _env_int("KUBEML_SERVING_FETCHERS", 6))
+    # size decode chunks down to the earliest completion under queue
+    # pressure (measured neutral on chip; kept for drain phases)
+    serving_pressure_sizing: bool = field(
+        default_factory=lambda: _env_bool("KUBEML_SERVING_PRESSURE_SIZING", True))
     # SHARDED serving: axis spec like "tp=2" — finished (sharded) checkpoints
     # restore straight onto this mesh and the batcher runs one SPMD decode
     # program over it, so a model too big for one chip still serves. Empty

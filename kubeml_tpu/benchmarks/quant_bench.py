@@ -24,10 +24,20 @@ PROMPT_LEN = 32
 VOCAB = 32000
 
 
-def _served(max_len: int):
-    from ..models.gpt import GPTSmall
+def _served(max_len: int, model: str = "small"):
+    from ..models.gpt import CausalTransformer, GPTSmall
 
-    module = GPTSmall(vocab_size=VOCAB, max_len=max_len, dtype=jnp.bfloat16)
+    if model == "large":
+        # GPT-2-large class (~774M): weight traffic ~1.5 GB/step bf16 — the
+        # regime where decode IS HBM-bound on a v5e and the int8 cut shows
+        # (GPT-2-small's 124M streams only ~200 GB/s at measured step rate,
+        # a quarter of HBM: per-op overhead dominates and int8 buys ~0)
+        module = CausalTransformer(vocab_size=VOCAB, max_len=max_len,
+                                   embed_dim=1280, depth=36, num_heads=20,
+                                   dtype=jnp.bfloat16)
+    else:
+        module = GPTSmall(vocab_size=VOCAB, max_len=max_len,
+                          dtype=jnp.bfloat16)
     r = np.random.default_rng(0)
     prompt = jnp.asarray(r.integers(1, VOCAB, size=(1, PROMPT_LEN)), jnp.int32)
     variables = module.init(jax.random.PRNGKey(0), prompt)
@@ -44,14 +54,19 @@ def _served(max_len: int):
 
 
 def decode_rate(module, variables, *, batch: int, new_tokens: int,
-                quantize: str, reps: int = 3) -> dict:
+                quantize: str, reps: int = 3,
+                chunk_steps: int = 16) -> dict:
     """Sustained decode tokens/sec through the batcher at a fixed batch:
     B requests fill B slots, the engine advances them in lockstep; the rep
-    clock starts after warmup (compiles amortized out)."""
+    clock starts after warmup (compiles amortized out). On the tunneled dev
+    chip, small chunks measure the DISPATCH pipeline, not the device — pass
+    a large ``chunk_steps`` (e.g. new_tokens/2) to amortize the per-program
+    round trip and expose the device-side rate the int8 claim is about."""
     from ..api.types import GenerateRequest
     from ..serving.batcher import BatchingDecoder
 
-    dec = BatchingDecoder(module, variables, slots=batch, chunk_steps=16,
+    dec = BatchingDecoder(module, variables, slots=batch,
+                          chunk_steps=chunk_steps,
                           quantize=quantize, name=f"qbench-{quantize or 'bf16'}")
     r = np.random.default_rng(1)
 
@@ -78,12 +93,14 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="int8 vs bf16 decode bench")
     p.add_argument("--batches", default="1,8,16")
     p.add_argument("--new-tokens", type=int, default=128)
+    p.add_argument("--chunk-steps", type=int, default=16)
+    p.add_argument("--model", default="small", choices=("small", "large"))
     p.add_argument("--reps", type=int, default=3)
     p.add_argument("--skip-quality", action="store_true")
     args = p.parse_args(argv)
     batches = [int(b) for b in args.batches.split(",")]
 
-    module, variables = _served(PROMPT_LEN + args.new_tokens)
+    module, variables = _served(PROMPT_LEN + args.new_tokens, args.model)
 
     if not args.skip_quality:
         from ..serving.quant import quality_report
@@ -96,13 +113,14 @@ def main(argv=None) -> int:
             k: round(v, 5) for k, v in q.items()}}), flush=True)
 
     for batch in batches:
-        row = {"metric": "decode-rate", "batch": batch,
-               "new_tokens": args.new_tokens}
+        row = {"metric": "decode-rate", "model": args.model, "batch": batch,
+               "new_tokens": args.new_tokens,
+               "chunk_steps": args.chunk_steps}
         # interleave modes per batch: same-regime comparison on a shared chip
         for mode in ("", "int8"):
             r = decode_rate(module, variables, batch=batch,
                             new_tokens=args.new_tokens, quantize=mode,
-                            reps=args.reps)
+                            reps=args.reps, chunk_steps=args.chunk_steps)
             key = mode or "bf16"
             row[f"{key}_tokens_per_sec"] = r["tokens_per_sec"]
             row[f"{key}_weight_bytes"] = r["weight_bytes"]

@@ -1,0 +1,155 @@
+"""ResNet MFU attribution — where the other ~60% of the chip goes.
+
+VERDICT r4 weak-2/next-3: the corrected roofline says the flagship K-AVG
+ResNet-18 round is compute-bound (ceiling 1.0) but 40% MFU leaves most of
+the chip unexplained. This runs the EXACT benchmark round (bench.py's
+flagship config) under the JAX profiler's perfetto device trace and
+aggregates on-device op time by fused-computation name, classifying each
+into MXU (convolution/dot), VPU/elementwise, reductions, and
+data-movement. The output is the per-op evidence table the certificate (or
+the fix) is written from.
+
+    python -m kubeml_tpu.benchmarks.resnet_attrib --rounds 3
+
+One JSON line per aggregated op class + a top-N op table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import tempfile
+import time
+from collections import defaultdict
+
+import jax
+import numpy as np
+
+
+def _classify(name: str) -> str:
+    n = name.lower()
+    if "conv" in n or "dot" in n or "einsum" in n:
+        return "mxu(conv/dot)"
+    if any(k in n for k in ("reduce-window", "select-and-scatter")):
+        return "pooling"
+    if any(k in n for k in ("reduce", "all-reduce")):
+        return "reduce"
+    if any(k in n for k in ("copy", "transpose", "reshape", "bitcast",
+                            "concatenate", "slice", "pad", "gather",
+                            "scatter", "dynamic-update")):
+        return "data-movement"
+    if any(k in n for k in ("fusion", "add", "multiply", "subtract",
+                            "divide", "maximum", "exp", "log", "rsqrt",
+                            "compare", "select", "convert", "tanh")):
+        return "vpu/elementwise"
+    return "other"
+
+
+def _device_events(trace_dir: str):
+    """(name, dur_us) device events from the newest perfetto trace in
+    ``trace_dir``. Host threads are excluded by track: TPU op tracks carry
+    'XLA Ops' / device names in their thread names."""
+    paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.json.gz"),
+                             recursive=True), key=os.path.getmtime)
+    if not paths:
+        raise RuntimeError(f"no perfetto trace written under {trace_dir}")
+    with gzip.open(paths[-1], "rt") as f:
+        trace = json.load(f)
+    events = (trace if isinstance(trace, list)
+              else trace.get("traceEvents", []))
+    # map tid/pid -> thread name to find device op tracks
+    tracks = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            tracks[(e.get("pid"), e.get("tid"))] = e["args"].get("name", "")
+    out = []
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        tname = tracks.get((e.get("pid"), e.get("tid")), "")
+        if "xla op" in tname.lower() or "tensorflow op" in tname.lower():
+            out.append((e.get("name", "?"), float(e["dur"])))
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="flagship K-AVG round attribution")
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--top", type=int, default=25)
+    p.add_argument("--dtype", default="bf16", choices=("bf16", "f32"),
+                   help="model compute dtype — default matches bench.py's "
+                        "flagship (bf16 since round 5); f32 reproduces the "
+                        "round-4 recipe the attribution was first run on")
+    p.add_argument("--out", default=None, help="write the table JSON here")
+    args = p.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from .harness import flagship, make_synthetic_model
+    from ..engine.kavg import KAvgTrainer
+
+    fs = flagship(dtype=jnp.bfloat16 if args.dtype == "bf16" else None)
+    model = make_synthetic_model(fs.module, "attrib-synthetic",
+                                 uint8_inputs=True)
+    n_workers = max(1, len(jax.devices()))
+    batch, k = 128, 8
+    trainer = KAvgTrainer(model, precision="bf16")
+    rng = jax.random.PRNGKey(0)
+    r = np.random.default_rng(0)
+    x = r.integers(0, 256, size=(n_workers, k, batch, *fs.sample_shape)).astype(np.uint8)
+    y = r.integers(0, fs.num_classes, size=(n_workers, k, batch)).astype(np.int64)
+    mask = np.ones((n_workers, k, batch), np.float32)
+    variables = trainer.init_variables(rng, x[0, 0], n_workers)
+    sx, sy, sm = trainer.stage_round(x, y, mask, n_workers)
+    variables, loss = trainer.sync_round(variables, sx, sy, sm, rng, lr=0.1)
+    float(loss)  # compile + drain
+
+    trace_dir = tempfile.mkdtemp(prefix="kubeml-attrib-")
+    t0 = time.perf_counter()
+    with jax.profiler.trace(trace_dir, create_perfetto_trace=True):
+        for i in range(args.rounds):
+            variables, loss = trainer.sync_round(
+                variables, sx, sy, sm, jax.random.fold_in(rng, i), lr=0.1)
+        float(loss)
+    wall = time.perf_counter() - t0
+
+    events = _device_events(trace_dir)
+    by_op = defaultdict(float)
+    for name, dur in events:
+        by_op[name] += dur
+    total = sum(by_op.values())
+    by_class = defaultdict(float)
+    for name, dur in by_op.items():
+        by_class[_classify(name)] += dur
+
+    samples = args.rounds * n_workers * k * batch
+    result = {
+        "metric": "resnet-attribution",
+        "rounds": args.rounds,
+        "wall_s": round(wall, 2),
+        "device_op_time_us": round(total, 1),
+        "device_busy_frac_of_wall": round(total / 1e6 / wall, 4),
+        "samples_per_sec_wall": round(samples / wall, 1),
+        "classes": {c: {"us": round(v, 1), "frac": round(v / total, 4)}
+                    for c, v in sorted(by_class.items(),
+                                       key=lambda kv: -kv[1])},
+        "top_ops": [
+            {"op": name, "us": round(dur, 1), "frac": round(dur / total, 4)}
+            for name, dur in sorted(by_op.items(), key=lambda kv: -kv[1])
+            [: args.top]
+        ],
+    }
+    print(json.dumps(result), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

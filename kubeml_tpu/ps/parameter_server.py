@@ -907,7 +907,10 @@ class ParameterServer:
         decoder = BatchingDecoder(
             module, variables, slots=self.cfg.serving_slots,
             chunk_steps=self.cfg.serving_chunk_steps, name=model_id,
-            mesh=mesh, quantize=quantize)
+            mesh=mesh, quantize=quantize,
+            pipeline_depth=self.cfg.serving_pipeline,
+            fetchers=self.cfg.serving_fetchers,
+            pressure_sizing=self.cfg.serving_pressure_sizing)
         stale = []
         with self._lock:
             # double-checked: a racing thread may have built one meanwhile —

@@ -211,8 +211,10 @@ class BatchingDecoder:
 
     def __init__(self, module, variables, *, slots: int = 8,
                  chunk_steps: int = 8, bucket_min: int = 16,
-                 pipeline_depth: int = 4, name: str = "decoder",
-                 mesh=None, quantize: str = ""):
+                 pipeline_depth: Optional[int] = None, name: str = "decoder",
+                 mesh=None, quantize: str = "",
+                 fetchers: Optional[int] = None,
+                 pressure_sizing: Optional[bool] = None):
         cap = getattr(module, "max_len", None)
         if cap is None:
             raise GenerationInputError(
@@ -236,13 +238,24 @@ class BatchingDecoder:
         # restores straight onto these shardings (no host ever materializes
         # a full leaf), closing the train-big-serve-small gap.
         self.mesh = mesh
-        # dispatch pipelining: the device may run up to this many programs
-        # ahead of the host's processed state. Chip-measured necessity: each
-        # value fetch costs a ~110ms round trip through the dev tunnel, so a
-        # fetch-after-every-chunk loop ran at 3% of device rate; with the
-        # chain pipelined (and fetches on their own threads) the device
-        # never waits for the host.
-        self.pipeline_depth = int(pipeline_depth)
+        # dispatch pipelining: the device may run up to pipeline_depth
+        # programs ahead of the host's processed state (each value fetch
+        # costs a ~110ms round trip through the dev tunnel — an unpipelined
+        # loop measured 3% of device rate). Chip-measured defaults live in
+        # Config (results/SERVING_R5_NOTE.md — depth must be >= fetchers to
+        # saturate the pool; deeper delays completion detection and burns
+        # dead steps on long requests). Explicit args win; None falls back
+        # to the process config.
+        from ..api.config import get_config
+
+        cfg = get_config()
+        self.pipeline_depth = int(pipeline_depth if pipeline_depth is not None
+                                  else cfg.serving_pipeline)
+        self.fetchers = int(fetchers if fetchers is not None
+                            else cfg.serving_fetchers)
+        self.pressure_sizing = bool(
+            pressure_sizing if pressure_sizing is not None
+            else cfg.serving_pressure_sizing)
         self.name = name
         # weight-only int8 (serving/quant.py): halves the per-step weight
         # HBM traffic decode is bound on; the dequantize is traced inside
@@ -640,8 +653,6 @@ class BatchingDecoder:
     def _busy(self) -> bool:
         return any(r is not None for r in self._slot_rows)
 
-    _FETCHERS = 2  # concurrent value fetches (each pays its own tunnel RTT)
-
     def _loop(self) -> None:
         """The engine: an event-driven PIPELINED dispatch chain.
 
@@ -691,7 +702,7 @@ class BatchingDecoder:
 
         fetchers = [threading.Thread(target=fetcher, daemon=True,
                                      name=f"decode-fetch-{self.name}-{i}")
-                    for i in range(self._FETCHERS)]
+                    for i in range(self.fetchers)]
         for t in fetchers:
             t.start()
         next_seq = 0       # next dispatch sequence number
@@ -887,7 +898,8 @@ class BatchingDecoder:
                 size = t
         with self._cond:
             pressure = bool(self._pending)
-        if pressure and len(self._chunk_sizes) > 1:
+        if (self.pressure_sizing and pressure
+                and len(self._chunk_sizes) > 1):
             soonest = min((n for n in self._remaining_steps() if n > 0),
                           default=needed)
             for t in self._chunk_sizes:  # smallest size covering `soonest`

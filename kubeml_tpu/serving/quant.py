@@ -1,12 +1,19 @@
 """Weight-only int8 quantization for the decode path.
 
-Decode is HBM-bound on the per-step WEIGHT reads (every generated token
-re-reads the whole model; serving/batcher.py's design rests on this —
-batch is nearly free because the weight traffic dominates). int8 weights
-with per-output-channel scales halve that traffic vs bf16 (4x vs f32), so
-small-batch decode throughput should approach 2x; the dequantize runs
-INSIDE the step program (int8 leaves the HBM, the convert+scale happens
-on-chip next to the matmul, where decode has FLOPs to spare).
+Every generated token re-reads the whole model, so int8 weights with
+per-output-channel scales halve the per-step weight HBM traffic vs bf16
+(4x vs f32) and halve the weight FOOTPRINT (a ~2x-larger model fits one
+chip). The dequantize runs INSIDE the step program (int8 leaves the HBM;
+verified in the compiled HLO — the weights stay s8, nothing is hoisted
+out of the scan).
+
+Chip-measured reality (results/QUANT_R5_NOTE.md): the THROUGHPUT win is
+modest on a v5e at the 124M-774M scale — +4-11% at batch 1 (largest for
+the 774M class, whose bf16 step streams ~54% of HBM), ~0 at batch 8-16 —
+because per-op overhead and the on-chip convert+scale absorb most of the
+saved stream time. Weight-only dequant cannot reach the naive 2x; that
+needs native int8 matmuls (quantized activations on the MXU int8 path),
+which is future work, not claimed here.
 
 Scheme: symmetric per-output-channel int8 —
 
