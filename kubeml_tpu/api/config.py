@@ -103,8 +103,9 @@ class Config:
     # weight-only int8 decode ("int8"; empty = off): halves the per-step
     # weight HBM traffic and the weight footprint (serving/quant.py;
     # chip-measured +4-11% decode at batch 1 for 124M-774M classes,
-    # ~neutral at batch >= 8 — results/QUANT_R5_NOTE.md). Single-device
-    # serving only (ignored when a serving mesh is set).
+    # ~neutral at batch >= 8 — results/QUANT_R5_NOTE.md). Composes with
+    # the serving mesh: flat-checkpoint loads quantize BEFORE placement
+    # (int8-sized per-device peak), q/scales shard with the tp specs.
     serving_quantize: str = field(
         default_factory=lambda: os.environ.get("KUBEML_SERVING_QUANTIZE", ""))
     # dispatch-chain depth: decode programs the device may run ahead of the

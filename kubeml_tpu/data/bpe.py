@@ -86,6 +86,8 @@ def train_bpe(corpus: str, vocab_size: int) -> Dict:
         words.append(list(_chunk_ids(chunk)))
         freqs.append(f)
 
+    import heapq
+
     pair_counts: Counter = Counter()
     pair_words: Dict[Tuple[int, int], set] = {}
     for wi, w in enumerate(words):
@@ -93,16 +95,28 @@ def train_bpe(corpus: str, vocab_size: int) -> Dict:
             pair_counts[pair] += freqs[wi]
             pair_words.setdefault(pair, set()).add(wi)
 
+    # lazy-invalidation max-heap over (-count, pair): a full scan of the
+    # live pair table per merge is O(pairs x merges) — minutes for a real
+    # corpus at 16k merges. Entries go stale when counts change; the pop
+    # loop discards any entry whose count no longer matches the table.
+    # Tuple order gives the same deterministic tie-break as the scan
+    # (highest count, then smallest pair).
+    heap = [(-c, p) for p, c in pair_counts.items()]
+    heapq.heapify(heap)
+
+    def touch(pair):
+        c = pair_counts.get(pair)
+        if c:
+            heapq.heappush(heap, (-c, pair))
+
     merges: List[Tuple[int, int]] = []
     next_id = MERGE_BASE
-    while next_id < vocab_size and pair_counts:
-        # max by (count, -pair) => deterministic smallest-pair tiebreak
-        best, best_count = None, 1
-        for pair, c in pair_counts.items():
-            if c > best_count or (c == best_count and best is not None
-                                  and pair < best):
-                best, best_count = pair, c
-        if best is None:  # nothing repeats: the corpus is fully compressed
+    while next_id < vocab_size and heap:
+        neg, best = heapq.heappop(heap)
+        current = pair_counts.get(best)
+        if current is None or -neg != current:
+            continue  # stale entry
+        if current < 2:  # nothing repeats: the corpus is fully compressed
             break
         merges.append(best)
         new_id = next_id
@@ -110,11 +124,15 @@ def train_bpe(corpus: str, vocab_size: int) -> Dict:
         for wi in list(pair_words.get(best, ())):
             w = words[wi]
             f = freqs[wi]
-            # remove this word's old pair contributions
+            # remove this word's old pair contributions (decremented pairs
+            # re-enter the heap at their new count — their old entries are
+            # stale and would otherwise be their ONLY entries)
             for pair in zip(w, w[1:]):
                 pair_counts[pair] -= f
                 if pair_counts[pair] <= 0:
                     del pair_counts[pair]
+                else:
+                    touch(pair)
                 ws = pair_words.get(pair)
                 if ws is not None:
                     ws.discard(wi)
@@ -126,6 +144,7 @@ def train_bpe(corpus: str, vocab_size: int) -> Dict:
             for pair in zip(merged, merged[1:]):
                 pair_counts[pair] += f
                 pair_words.setdefault(pair, set()).add(wi)
+                touch(pair)
     return {"kind": "bpe", "vocab_size": int(next_id),
             "merges": [[int(a), int(b)] for a, b in merges]}
 

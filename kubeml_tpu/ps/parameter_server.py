@@ -900,10 +900,6 @@ class ParameterServer:
             log.warning("KUBEML_SERVING_QUANTIZE=%r not recognized "
                         "(valid: int8) — serving unquantized", quantize)
             quantize = ""
-        if quantize and mesh is not None:
-            log.warning("KUBEML_SERVING_QUANTIZE=%s ignored: int8 does not "
-                        "compose with the serving mesh yet", quantize)
-            quantize = ""
         decoder = BatchingDecoder(
             module, variables, slots=self.cfg.serving_slots,
             chunk_steps=self.cfg.serving_chunk_steps, name=model_id,

@@ -96,6 +96,27 @@ def _param_shardings(module, mesh):
                         is_leaf=lambda x: isinstance(x, P))
 
 
+def _quantized_shardings(qtree, dense_shardings, mesh):
+    """Map a DENSE NamedSharding tree onto a quantized tree: each
+    QuantizedTensor gets its kernel's sharding for ``q`` and the last
+    (channel) axis's sharding for its broadcast-shaped per-channel ``s``;
+    dense leaves keep their sharding."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .quant import QuantizedTensor, _is_q
+
+    def one(qleaf, sh):
+        if not isinstance(qleaf, QuantizedTensor):
+            return sh
+        axes = tuple(sh.spec)
+        ndim = qleaf.q.ndim
+        axes = axes + (None,) * (ndim - len(axes))
+        s_axes = (None,) * (ndim - 1) + (axes[-1],)
+        return QuantizedTensor(q=sh, s=NamedSharding(mesh, P(*s_axes)))
+
+    return jax.tree.map(one, qtree, dense_shardings, is_leaf=_is_q)
+
+
 def _sample_rows(logits, keys, temp, topk, active=None):
     """One next-token draw per row with PER-ROW runtime knobs.
 
@@ -258,18 +279,17 @@ class BatchingDecoder:
             else cfg.serving_pressure_sizing)
         self.name = name
         # weight-only int8 (serving/quant.py): halves the per-step weight
-        # HBM traffic decode is bound on; the dequantize is traced inside
-        # the scan body (_apply_step) so each step reads int8, not a
-        # materialized bf16 copy. Single-device path (the small-batch case
-        # the bandwidth argument targets).
+        # HBM traffic and footprint; the dequantize is traced inside the
+        # scan body (_apply_step) so each step reads int8, not a
+        # materialized bf16 copy. COMPOSES with the serving mesh: the
+        # quantize runs AFTER placement as eager SPMD ops, so q inherits
+        # the kernel's tp sharding and the per-channel scales shard with
+        # their channel axis.
         if quantize not in ("", "int8"):
             raise ValueError(f"unknown quantize mode {quantize!r} "
                              f"(valid: '', 'int8')")
-        if quantize == "int8" and mesh is not None:
-            raise ValueError("int8 serving does not compose with a serving "
-                             "mesh yet; unset one of them")
         self.quantize = quantize
-        if quantize == "int8":
+        if quantize == "int8" and mesh is None:
             from .quant import quantize_tree
 
             variables = quantize_tree(variables)
@@ -284,8 +304,29 @@ class BatchingDecoder:
                 isinstance(l, jax.Array)
                 and getattr(l.sharding, "mesh", None) == mesh
                 for l in leaves)
-            self._variables = (variables if placed else jax.device_put(
-                variables, _param_shardings(module, mesh)))
+            if quantize == "int8":
+                from .quant import quantize_tree
+
+                if placed:
+                    # dense tree already resident (sharded restore paid the
+                    # bf16/f32 transient when it placed it): quantize in
+                    # place. Removing that transient entirely needs
+                    # quantized checkpoint STORAGE — future work.
+                    self._variables = quantize_tree(variables)
+                else:
+                    # quantize BEFORE placement so per-device HBM peaks at
+                    # the int8 tree plus one dense leaf (the quantize's own
+                    # working set) — a model sized to int8-per-slice must
+                    # not need its full dense shard to fit first
+                    qvars = quantize_tree(variables)
+                    self._variables = jax.device_put(
+                        qvars, _quantized_shardings(
+                            qvars, _param_shardings(module, mesh), mesh))
+            elif placed:
+                self._variables = variables
+            else:
+                self._variables = jax.device_put(
+                    variables, _param_shardings(module, mesh))
         else:
             self._variables = jax.device_put(variables)
         # per-step weight HBM bytes (the bandwidth accounting the int8 win
