@@ -324,6 +324,9 @@ def cmd_checkpoint(args) -> int:
     elif args.action == "export":
         dest = c.export(args.id, args.out, epoch=args.epoch)
         print(f"exported {args.id} -> {dest}")
+    elif args.action == "quantize":
+        out = c.quantize(args.id)
+        print(f"quantized {args.id} -> tag {out['tag']} ({out['form']})")
     elif args.action == "delete":
         c.delete(args.id)
         print(f"deleted checkpoints of {args.id}")
@@ -561,6 +564,10 @@ def build_parser() -> argparse.ArgumentParser:
     ce.add_argument("--id", required=True)
     ce.add_argument("--out", required=True, help="destination .npz path")
     ce.add_argument("--epoch", type=int, default=None)
+    cq = csub.add_parser("quantize",
+                         help="write an int8 final-int8 export (int8-"
+                              "configured serving prefers it)")
+    cq.add_argument("--id", required=True)
     cd = csub.add_parser("delete")
     cd.add_argument("--id", required=True)
     c.set_defaults(fn=cmd_checkpoint)

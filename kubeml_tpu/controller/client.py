@@ -258,6 +258,13 @@ class _Checkpoints:
         dest.write_bytes(resp.content)
         return dest
 
+    def quantize(self, job_id: str) -> dict:
+        """Offline int8 quantization of the job's final export (writes the
+        ``final-int8`` tag; int8-configured serving prefers it)."""
+        return _check(requests.post(
+            f"{self.c.url}/checkpoint/{job_id}/quantize",
+            timeout=max(self.c.timeout, 600)))
+
     def delete(self, job_id: str, tag: Optional[str] = None) -> None:
         params = {"tag": tag} if tag else {}
         _check(

@@ -64,6 +64,7 @@ class Controller:
         router.route("GET", "/checkpoint", self._ckpt_list_all)
         router.route("GET", "/checkpoint/{id}", self._ckpt_list)
         router.route("GET", "/checkpoint/{id}/export", self._ckpt_export)
+        router.route("POST", "/checkpoint/{id}/quantize", self._ckpt_quantize)
         router.route("DELETE", "/checkpoint/{id}", self._ckpt_delete)
         router.route("GET", "/function", self._fn_list)
         router.route("GET", "/function/{name}", self._fn_get)
@@ -223,6 +224,26 @@ class Controller:
         self.checkpoints.save(job, ck.variables, epoch=ck.epoch, tag=ck.tag,
                               meta=ck.meta)
         return self.checkpoints.export_path(job, tag=ck.tag)
+
+    def _ckpt_quantize(self, req: Request):
+        """Offline int8 quantization of a job's final export: writes the
+        ``final-int8`` tag next to the dense final (serving with
+        KUBEML_SERVING_QUANTIZE=int8 then prefers it — restores int8
+        straight onto the serving mesh with no dense transient)."""
+        from ..api.errors import CheckpointNotFoundError
+        from ..serving.quant import INT8_TAG, quantize_final_checkpoint
+
+        job = req.params["id"]
+        try:
+            # the registry resolves the job's function from the checkpoint's
+            # own metadata (a pipeline-trained model re-layouts to serving
+            # shape before quantizing; an unresolvable function is a 400)
+            form = quantize_final_checkpoint(
+                job, self.checkpoints, self._sharded_checkpoints,
+                registry=self.registry)
+        except CheckpointNotFoundError:
+            raise KubeMLError(f"job {job!r} has no final checkpoint", 404)
+        return {"job": job, "tag": INT8_TAG, "form": form}
 
     def _ckpt_delete(self, req: Request):
         from ..api.errors import CheckpointNotFoundError

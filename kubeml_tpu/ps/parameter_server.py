@@ -835,7 +835,8 @@ class ParameterServer:
         self.metrics.task_started("inference")
         try:
             return self._maybe_stream(
-                generate_from_request(model.module, variables, req), req)
+                generate_from_request(model.module,
+                                      self._densified(variables), req), req)
         finally:
             self.metrics.task_finished("inference")
 
@@ -973,6 +974,19 @@ class ParameterServer:
         finally:
             self.metrics.task_finished("inference")
 
+    @staticmethod
+    def _densified(variables):
+        """Dense view of possibly-int8 serving variables for the paths that
+        consume a plain tree (classifier /infer, the one-shot generate
+        fallback) — the batcher consumes QuantizedTensor leaves natively."""
+        from ..serving.quant import dequantize_tree, is_quantized_tree
+
+        if is_quantized_tree(variables):
+            import jax.numpy as jnp
+
+            return dequantize_tree(variables, jnp.float32)
+        return variables
+
     def _serving_telemetry(self) -> dict:
         """{model_id: telemetry} across the resident decoders (the /metrics
         serving source; VERDICT r4 weak-4 — the serving runtime gets the
@@ -999,28 +1013,51 @@ class ParameterServer:
         return store
 
     def _final_source(self, model_id: str):
-        """(kind, mtime_ns) of the freshest final checkpoint — ``"flat"``
+        """(kind, tag, mtime_ns) of the checkpoint to serve — ``"flat"``
         (single-replica export) or ``"sharded"`` (gather-free manifest +
         per-process slices, the SPMD engine's sharded_checkpoints export) —
-        or (None, None). A malformed/unknown id is a 404, never a 500."""
+        or (None, None, None). With ``KUBEML_SERVING_QUANTIZE=int8`` a
+        pre-quantized ``final-int8`` export (serving.quant.
+        quantize_final_checkpoint) is PREFERRED: it restores int8 straight
+        onto the serving mesh with no dense transient. A malformed/unknown
+        id is a 404, never a 500."""
         from ..api.errors import CheckpointNotFoundError, StorageError
 
-        flat = sharded = None
-        try:
-            flat = self._ckpt_store.export_path(
-                model_id, tag=FINAL_TAG).stat().st_mtime_ns
-        except (CheckpointNotFoundError, StorageError, OSError):
-            pass
-        try:
-            sharded = self._serving_sharded_store().manifest_path(
-                model_id, FINAL_TAG).stat().st_mtime_ns
-        except (StorageError, OSError):
-            pass
-        if flat is None and sharded is None:
-            return None, None
-        if sharded is None or (flat is not None and flat >= sharded):
-            return "flat", flat
-        return "sharded", sharded
+        def resolve(tag):
+            flat = sharded = None
+            try:
+                flat = self._ckpt_store.export_path(
+                    model_id, tag=tag).stat().st_mtime_ns
+            except (CheckpointNotFoundError, StorageError, OSError):
+                pass
+            try:
+                sharded = self._serving_sharded_store().manifest_path(
+                    model_id, tag).stat().st_mtime_ns
+            except (StorageError, OSError):
+                pass
+            if flat is None and sharded is None:
+                return None
+            if sharded is None or (flat is not None and flat >= sharded):
+                return ("flat", tag, flat)
+            return ("sharded", tag, sharded)
+
+        dense = resolve(FINAL_TAG)
+        if self.cfg.serving_quantize == "int8":
+            from ..serving.quant import INT8_TAG
+
+            int8 = resolve(INT8_TAG)
+            # prefer the quantized export only while it is at least as
+            # fresh as the dense final — a retrain under the same id must
+            # not be shadowed forever by a stale final-int8
+            if int8 is not None and (dense is None or int8[2] >= dense[2]):
+                return int8
+            if int8 is not None:
+                log.debug("%s: final-int8 is older than the dense final — "
+                          "serving dense (re-run `checkpoint quantize`)",
+                          model_id)
+        if dense is None:
+            return None, None, None
+        return dense
 
     def _serving_mesh_for(self, model):
         """The configured serving mesh (Config.serving_mesh, e.g. "tp=2"),
@@ -1059,44 +1096,61 @@ class ParameterServer:
                           axes)
             return None
 
-    def _build_serving(self, model_id: str, kind: str, mtime) -> tuple:
+    def _build_serving(self, model_id: str, kind: str, tag: str,
+                       mtime) -> tuple:
         """(model, variables, mtime, mesh) from the final checkpoint. The
         model's ``serving_remap`` re-layouts training-shaped checkpoints
         (e.g. pipeline-stacked stages) into the serving module's layout; a
         sharded final restores per-slice straight onto the serving mesh —
-        no host materializes the full tree (VERDICT r4 next-1)."""
+        no host materializes the full tree (VERDICT r4 next-1). A
+        ``final-int8`` export restores its int8 values/scales directly
+        (storage markers -> QuantizedTensor tree; serving-layout already,
+        so the remap never re-applies)."""
         from ..api.errors import CheckpointNotFoundError
+        from ..serving.quant import from_storage_tree, is_quantized_storage
 
         if kind == "flat":
             try:
-                ck = self._ckpt_store.restore(model_id, tag=FINAL_TAG)
+                ck = self._ckpt_store.restore(model_id, tag=tag)
             except CheckpointNotFoundError:
                 raise JobNotFoundError(model_id)
             fn_name = ck.meta.get("request", {}).get("function_name", "")
             model = self.registry.load(fn_name)
             variables = ck.variables
+            if is_quantized_storage(variables):
+                variables = from_storage_tree(variables)
             remap = model.serving_remap()
-            if remap is not None:
+            if remap is not None and ck.meta.get("layout") != "serving":
                 from ..storage.sharded_checkpoint import apply_remap_host
 
                 variables = apply_remap_host(variables, remap)
             return (model, variables, mtime, self._serving_mesh_for(model))
         store = self._serving_sharded_store()
         try:
-            manifest = store.read_manifest(model_id, FINAL_TAG)
+            manifest = store.read_manifest(model_id, tag)
         except CheckpointNotFoundError:
             raise JobNotFoundError(model_id)
         fn_name = (manifest.get("meta", {}).get("request", {})
                    .get("function_name", ""))
         model = self.registry.load(fn_name)
-        remap = model.serving_remap()
+        quantized = any(p.rsplit("/", 1)[-1].startswith("__q8_")
+                        for p in manifest["leaves"])
+        remap = (None if (quantized
+                          or manifest.get("meta", {}).get("layout") == "serving")
+                 else model.serving_remap())
         mesh = self._serving_mesh_for(model)
         shardings = None
         if mesh is not None:
-            from ..serving.batcher import _param_shardings
-
             try:
-                shardings = _param_shardings(model.module, mesh)
+                if quantized:
+                    from ..serving.batcher import storage_shardings
+
+                    shardings = storage_shardings(
+                        manifest["leaves"], model.module, mesh)
+                else:
+                    from ..serving.batcher import _param_shardings
+
+                    shardings = _param_shardings(model.module, mesh)
             except Exception:
                 # not a token-in LM (or no annotations): restore to host and
                 # serve single-device — the mesh only helps decode-capable
@@ -1104,15 +1158,17 @@ class ParameterServer:
                 log.debug("deriving serving shardings for %s failed; "
                           "restoring to host", model_id, exc_info=True)
                 mesh = None
-        ck = store.restore(model_id, FINAL_TAG, shardings=shardings,
-                           remap=remap)
-        return (model, ck.variables, mtime, mesh)
+        ck = store.restore(model_id, tag, shardings=shardings, remap=remap)
+        variables = ck.variables
+        if quantized:
+            variables = from_storage_tree(variables)
+        return (model, variables, mtime, mesh)
 
     def _load_serving(self, model_id: str):
         """(model, variables, mtime, serving mesh) for a FINISHED job from
         its exported final checkpoint (flat or sharded), via the
         mtime-validated serving cache. Shared by /infer and /generate."""
-        kind, mtime = self._final_source(model_id)
+        kind, tag, mtime = self._final_source(model_id)
         with self._lock:
             cached = self._serving_cache.get(model_id)
             if cached is not None and cached[2] != mtime:
@@ -1121,7 +1177,7 @@ class ParameterServer:
         if mtime is None:
             raise JobNotFoundError(model_id)
         if cached is None:
-            cached = self._build_serving(model_id, kind, mtime)
+            cached = self._build_serving(model_id, kind, tag, mtime)
             with self._lock:
                 self._serving_cache[model_id] = cached
                 while len(self._serving_cache) > SERVING_CACHE_SIZE:
@@ -1132,6 +1188,7 @@ class ParameterServer:
         import jax.numpy as jnp
 
         model, variables, _, _ = self._load_serving(model_id)
+        variables = self._densified(variables)
         self.metrics.task_started("inference")
         try:
             # same device-side input pipeline as training/live serving: a model

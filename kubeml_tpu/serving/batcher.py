@@ -117,6 +117,34 @@ def _quantized_shardings(qtree, dense_shardings, mesh):
     return jax.tree.map(one, qtree, dense_shardings, is_leaf=_is_q)
 
 
+def storage_shardings(manifest_leaves, module, mesh):
+    """Flat ``path -> NamedSharding`` tree for restoring a QUANTIZED
+    (storage-form) sharded checkpoint straight onto a serving mesh: marker
+    paths ``.../__q8_q__`` take their kernel's dense sharding, the
+    broadcast-shaped ``.../__q8_s__`` scales take their channel axis's,
+    and dense paths keep theirs — so a final-int8 restore never
+    materializes a dense leaf anywhere."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..storage.sharded_checkpoint import _flatten_any, _unflatten
+    from .quant import Q8_Q, Q8_S
+
+    flat_dense = dict(_flatten_any(_param_shardings(module, mesh)))
+    out = {}
+    for path, spec in manifest_leaves.items():
+        if path.endswith("/" + Q8_Q):
+            out[path] = flat_dense[path[: -len(Q8_Q) - 1]]
+        elif path.endswith("/" + Q8_S):
+            sh = flat_dense[path[: -len(Q8_S) - 1]]
+            ndim = len(spec["shape"])
+            axes = tuple(sh.spec) + (None,) * (ndim - len(tuple(sh.spec)))
+            out[path] = NamedSharding(
+                mesh, P(*((None,) * (ndim - 1)), axes[-1] if axes else None))
+        else:
+            out[path] = flat_dense[path]
+    return _unflatten(out)
+
+
 def _sample_rows(logits, keys, temp, topk, active=None):
     """One next-token draw per row with PER-ROW runtime knobs.
 
@@ -288,8 +316,15 @@ class BatchingDecoder:
         if quantize not in ("", "int8"):
             raise ValueError(f"unknown quantize mode {quantize!r} "
                              f"(valid: '', 'int8')")
+        from .quant import is_quantized_tree
+
+        pre_quantized = is_quantized_tree(variables)
+        if pre_quantized and quantize != "int8":
+            raise ValueError(
+                "variables carry int8 QuantizedTensor leaves but quantize "
+                "is not 'int8' — a dense decode program cannot consume them")
         self.quantize = quantize
-        if quantize == "int8" and mesh is None:
+        if quantize == "int8" and mesh is None and not pre_quantized:
             from .quant import quantize_tree
 
             variables = quantize_tree(variables)
@@ -308,17 +343,20 @@ class BatchingDecoder:
                 from .quant import quantize_tree
 
                 if placed:
-                    # dense tree already resident (sharded restore paid the
-                    # bf16/f32 transient when it placed it): quantize in
-                    # place. Removing that transient entirely needs
-                    # quantized checkpoint STORAGE — future work.
-                    self._variables = quantize_tree(variables)
+                    # already on the mesh. Pre-quantized (a final-int8
+                    # checkpoint restored slice-wise): NOTHING dense ever
+                    # touched the chip. Dense (a sharded dense restore):
+                    # quantize in place — that path already paid the dense
+                    # transient when the restore placed it.
+                    self._variables = (variables if pre_quantized
+                                       else quantize_tree(variables))
                 else:
                     # quantize BEFORE placement so per-device HBM peaks at
                     # the int8 tree plus one dense leaf (the quantize's own
                     # working set) — a model sized to int8-per-slice must
                     # not need its full dense shard to fit first
-                    qvars = quantize_tree(variables)
+                    qvars = (variables if pre_quantized
+                             else quantize_tree(variables))
                     self._variables = jax.device_put(
                         qvars, _quantized_shardings(
                             qvars, _param_shardings(module, mesh), mesh))

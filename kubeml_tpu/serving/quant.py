@@ -114,6 +114,77 @@ def dequantize_tree(variables: dict, dtype=jnp.bfloat16) -> dict:
     return jax.tree.map(one, variables, is_leaf=_is_q)
 
 
+INT8_TAG = "final-int8"
+
+
+def quantize_final_checkpoint(job_id: str, flat_store, sharded_store,
+                              registry=None) -> str:
+    """OFFLINE quantization of a job's final checkpoint: read the FRESHEST
+    final export (flat vs sharded resolved by mtime, the same rule serving
+    uses — a retrain must never quantize a stale form; the sharded path
+    assembles host-side on the control-plane host, not the serving chip),
+    quantize the weight leaves, and write the storage-form tree under the
+    ``final-int8`` tag in the same store form. Serving with
+    ``KUBEML_SERVING_QUANTIZE=int8`` then PREFERS this tag (when it is at
+    least as fresh as the dense final) and restores int8 straight onto the
+    serving mesh — no dense transient on the chip. Returns "flat" or
+    "sharded" (the form written).
+
+    ``registry`` resolves the job's function so a training-layout
+    checkpoint (pipeline stage-stacked) re-layouts to its SERVING shape
+    BEFORE quantizing — per-stage slices of stacked SCALES do not exist,
+    and the served module consumes flat blocks. A function that cannot be
+    loaded is an ERROR, not a silent skip: quantizing the wrong layout
+    would serve garbage with no error at quantize time."""
+    from ..api.errors import CheckpointNotFoundError, KubeMLError
+    from ..storage.checkpoint import FINAL_TAG
+
+    flat_mtime = sharded_mtime = None
+    try:
+        flat_mtime = flat_store.export_path(
+            job_id, tag=FINAL_TAG).stat().st_mtime_ns
+    except Exception:
+        pass
+    try:
+        sharded_mtime = sharded_store.manifest_path(
+            job_id, FINAL_TAG).stat().st_mtime_ns
+    except Exception:
+        pass
+    if flat_mtime is None and sharded_mtime is None:
+        raise CheckpointNotFoundError(job_id)
+    if sharded_mtime is None or (flat_mtime is not None
+                                 and flat_mtime >= sharded_mtime):
+        ck = flat_store.restore(job_id, tag=FINAL_TAG)
+        form = "flat"
+    else:
+        ck = sharded_store.restore(job_id, FINAL_TAG)  # host leaves
+        form = "sharded"
+    variables = ck.variables
+    if registry is not None:
+        fn_name = ck.meta.get("request", {}).get("function_name", "")
+        try:
+            model = registry.load(fn_name)
+        except Exception as e:
+            raise KubeMLError(
+                f"quantize needs job {job_id}'s function {fn_name!r} to "
+                f"determine the serving layout, but loading it failed: {e}",
+                400)
+        remap = model.serving_remap()
+        if remap is not None:
+            from ..storage.sharded_checkpoint import apply_remap_host
+
+            variables = apply_remap_host(variables, remap)
+    storage = to_storage_tree(quantize_tree(variables))
+    meta = {**ck.meta, "quantized": "int8", "layout": "serving"}
+    if form == "flat":
+        flat_store.save(job_id, storage, epoch=ck.epoch, tag=INT8_TAG,
+                        meta=meta)
+    else:
+        sharded_store.save(job_id, storage, epoch=ck.epoch, tag=INT8_TAG,
+                           meta=meta)
+    return form
+
+
 def quality_report(module, variables, tokens) -> dict:
     """Teacher-forced quality delta of int8 weights on a token batch: the
     bound the serving knob is published with (VERDICT r4 next-2 'bounded
@@ -135,6 +206,53 @@ def quality_report(module, variables, tokens) -> dict:
                             / jnp.maximum(jnp.linalg.norm(ref.ravel()), 1e-9)),
         "top1_agreement": float(agree),
     }
+
+
+# checkpoint-storage form: QuantizedTensor nodes become a marker dict so
+# the (dict-recursing) checkpoint stores persist them unchanged — and a
+# sharded restore can place q/s straight onto the serving mesh with no
+# dense transient (the "quantized checkpoint storage" follow-up of
+# results/QUANT_R5_NOTE.md)
+Q8_Q = "__q8_q__"
+Q8_S = "__q8_s__"
+
+
+def to_storage_tree(variables: dict) -> dict:
+    """QuantizedTensor nodes -> ``{Q8_Q: int8, Q8_S: scales}`` dicts (a
+    plain dict pytree both checkpoint stores persist as-is)."""
+
+    def one(leaf):
+        if _is_q(leaf):
+            return {Q8_Q: leaf.q, Q8_S: leaf.s}
+        return leaf
+
+    return jax.tree.map(one, variables, is_leaf=_is_q)
+
+
+def _is_storage_q(node) -> bool:
+    return isinstance(node, dict) and set(node) == {Q8_Q, Q8_S}
+
+
+def from_storage_tree(tree: dict) -> dict:
+    """Inverse of :func:`to_storage_tree`."""
+
+    def one(node):
+        if _is_storage_q(node):
+            return QuantizedTensor(q=node[Q8_Q], s=node[Q8_S])
+        return node
+
+    return jax.tree.map(one, tree, is_leaf=_is_storage_q)
+
+
+def is_quantized_tree(variables: dict) -> bool:
+    """True when the tree carries live QuantizedTensor leaves."""
+    return any(_is_q(l) for l in jax.tree.leaves(variables, is_leaf=_is_q))
+
+
+def is_quantized_storage(tree: dict) -> bool:
+    """True when a restored variables tree carries int8 storage markers."""
+    return any(_is_storage_q(n)
+               for n in jax.tree.leaves(tree, is_leaf=_is_storage_q))
 
 
 def quantized_bytes(variables: dict) -> int:
