@@ -108,6 +108,22 @@ class Config:
     # (int8-sized per-device peak), q/scales shard with the tp specs.
     serving_quantize: str = field(
         default_factory=lambda: os.environ.get("KUBEML_SERVING_QUANTIZE", ""))
+    # NATIVE int8 decode matmuls (with serving_quantize=int8): contract the
+    # activations against the int8 weights directly and fold the per-channel
+    # scale into the f32 accumulator AFTER the contraction
+    # (serving.quant.quantized_dot -> ops/int8_matmul.py) — no dense W~ is
+    # rebuilt inside the step program, which is what kept the round-5
+    # dequantize path at +4-11% of the 2x byte cut. Off (default) keeps the
+    # dequantize-then-matmul path.
+    int8_matmul: bool = field(
+        default_factory=lambda: _env_bool("KUBEML_INT8_MATMUL"))
+    # which quantized-matmul implementation quantized_dot dispatches to:
+    # "auto" (Pallas kernel on TPU, XLA dot_general fallback elsewhere),
+    # "pallas" (force the kernel; interpret mode off-TPU — the test path),
+    # "dot" (force the fallback)
+    int8_matmul_impl: str = field(
+        default_factory=lambda: os.environ.get("KUBEML_INT8_MATMUL_IMPL",
+                                               "auto"))
     # dispatch-chain depth: decode programs the device may run ahead of the
     # host's processed state. Must be >= serving_fetchers to saturate the
     # fetch pool; deeper delays completion detection (dead rows burn steps

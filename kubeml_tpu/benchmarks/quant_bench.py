@@ -1,11 +1,20 @@
-"""int8 vs bf16 decode throughput (VERDICT r4 next-2 done-criterion).
+"""bf16 vs int8-dequant vs int8-NATIVE decode throughput.
 
-Measures the continuous batcher's raw decode rate at batch 1/8/16 with
-full-precision and int8 weights on the GPT-2-small class, same process,
-interleaved (the dev chip's deliverable rate swings between minutes — each
-batch point measures bf16 and int8 back-to-back so the comparison is
-same-regime), plus the teacher-forced quality delta and the per-step
-weight-byte accounting. One JSON line per (batch, mode).
+Measures the continuous batcher's raw decode rate at batch 1-16 across
+THREE weight modes on the GPT-2 classes, same process, interleaved (the
+dev chip's deliverable rate swings between minutes — each batch point
+measures all modes back-to-back so the comparison is same-regime):
+
+* ``bf16``        — dense bf16 weights (the baseline stream)
+* ``int8``        — int8 weights, dequantized to a dense tree inside the
+                    step program (the round-5 path; +4-11% at batch 1)
+* ``int8_native`` — int8 weights contracted directly by quantized_dot
+                    (KUBEML_INT8_MATMUL; ops/int8_matmul.py) — the mode
+                    the 2x byte cut is supposed to show up in tokens/sec
+                    through (VERDICT r5 next-1)
+
+plus the teacher-forced quality delta and the per-step weight-byte
+accounting. One JSON line per batch with all three rates side by side.
 
     python -m kubeml_tpu.benchmarks.quant_bench --batches 1,8,16
 """
@@ -54,7 +63,7 @@ def _served(max_len: int, model: str = "small"):
 
 
 def decode_rate(module, variables, *, batch: int, new_tokens: int,
-                quantize: str, reps: int = 3,
+                quantize: str, int8_matmul: bool = False, reps: int = 3,
                 chunk_steps: int = 16) -> dict:
     """Sustained decode tokens/sec through the batcher at a fixed batch:
     B requests fill B slots, the engine advances them in lockstep; the rep
@@ -65,9 +74,10 @@ def decode_rate(module, variables, *, batch: int, new_tokens: int,
     from ..api.types import GenerateRequest
     from ..serving.batcher import BatchingDecoder
 
+    mode = ("int8_native" if int8_matmul else (quantize or "bf16"))
     dec = BatchingDecoder(module, variables, slots=batch,
-                          chunk_steps=chunk_steps,
-                          quantize=quantize, name=f"qbench-{quantize or 'bf16'}")
+                          chunk_steps=chunk_steps, quantize=quantize,
+                          int8_matmul=int8_matmul, name=f"qbench-{mode}")
     r = np.random.default_rng(1)
 
     def one_round(seed: int) -> float:
@@ -87,6 +97,36 @@ def decode_rate(module, variables, *, batch: int, new_tokens: int,
         dec.close()
     return {"tokens_per_sec": round(best, 1),
             "weight_bytes": int(dec.weight_bytes)}
+
+
+# (row key, decoder quantize mode, native int8 matmul)
+MODES = (("bf16", "", False), ("int8", "int8", False),
+         ("int8_native", "int8", True))
+
+
+def three_way_rows(module, variables, *, batches, new_tokens: int,
+                   chunk_steps: int = 16, reps: int = 3,
+                   model: str = "small") -> list:
+    """One row per batch with the bf16 / int8-dequant / int8-native decode
+    rates measured back-to-back (same regime on a shared chip) — the
+    comparison the chip harness records (bench.py, scripts/)."""
+    rows = []
+    for batch in batches:
+        row = {"metric": "decode-rate", "model": model, "batch": int(batch),
+               "new_tokens": new_tokens, "chunk_steps": chunk_steps}
+        for key, quantize, native in MODES:
+            r = decode_rate(module, variables, batch=batch,
+                            new_tokens=new_tokens, quantize=quantize,
+                            int8_matmul=native, reps=reps,
+                            chunk_steps=chunk_steps)
+            row[f"{key}_tokens_per_sec"] = r["tokens_per_sec"]
+            row[f"{key}_weight_bytes"] = r["weight_bytes"]
+        base = max(row["bf16_tokens_per_sec"], 1e-9)
+        row["int8_speedup"] = round(row["int8_tokens_per_sec"] / base, 3)
+        row["int8_native_speedup"] = round(
+            row["int8_native_tokens_per_sec"] / base, 3)
+        rows.append(row)
+    return rows
 
 
 def main(argv=None) -> int:
@@ -112,20 +152,11 @@ def main(argv=None) -> int:
         print(json.dumps({"metric": "int8-quality", **{
             k: round(v, 5) for k, v in q.items()}}), flush=True)
 
-    for batch in batches:
-        row = {"metric": "decode-rate", "model": args.model, "batch": batch,
-               "new_tokens": args.new_tokens,
-               "chunk_steps": args.chunk_steps}
-        # interleave modes per batch: same-regime comparison on a shared chip
-        for mode in ("", "int8"):
-            r = decode_rate(module, variables, batch=batch,
-                            new_tokens=args.new_tokens, quantize=mode,
-                            reps=args.reps, chunk_steps=args.chunk_steps)
-            key = mode or "bf16"
-            row[f"{key}_tokens_per_sec"] = r["tokens_per_sec"]
-            row[f"{key}_weight_bytes"] = r["weight_bytes"]
-        row["speedup"] = round(
-            row["int8_tokens_per_sec"] / max(row["bf16_tokens_per_sec"], 1e-9), 3)
+    # interleave modes per batch: same-regime comparison on a shared chip
+    for row in three_way_rows(module, variables, batches=batches,
+                              new_tokens=args.new_tokens,
+                              chunk_steps=args.chunk_steps, reps=args.reps,
+                              model=args.model):
         print(json.dumps(row), flush=True)
     return 0
 

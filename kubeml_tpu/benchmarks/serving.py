@@ -57,7 +57,7 @@ def one_shot_rate(batch: int, new_tokens: int = NEW_TOKENS, reps: int = 3) -> fl
 
 def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
              new_tokens: int = NEW_TOKENS, stagger: float = 0.0,
-             quantize: str = "") -> dict:
+             quantize: str = "", int8_matmul: bool = False) -> dict:
     """N HTTP clients against a live cluster serving a final checkpoint."""
     import os
     import socket
@@ -78,7 +78,8 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
 
     cfg = Config(controller_port=fp(), scheduler_port=fp(), ps_port=fp(),
                  storage_port=fp(), serving_slots=slots,
-                 serving_chunk_steps=chunk_steps, serving_quantize=quantize)
+                 serving_chunk_steps=chunk_steps, serving_quantize=quantize,
+                 int8_matmul=int8_matmul)
     cfg.ensure_dirs()
     set_config(cfg)
 
@@ -192,6 +193,10 @@ def main(argv=None) -> int:
                    help="spread client starts over this many seconds")
     p.add_argument("--quantize", default="",
                    help="serving weight quantization ('' or 'int8')")
+    p.add_argument("--int8-matmul", action="store_true",
+                   help="native int8 decode matmuls (with --quantize int8): "
+                        "contract activations against the int8 weights "
+                        "directly instead of dequantizing first")
     p.add_argument("--skip-comparator", action="store_true")
     args = p.parse_args(argv)
     # the dev chip is SHARED: its deliverable rate swings 2-7x between
@@ -201,9 +206,10 @@ def main(argv=None) -> int:
     ref_before = None if args.skip_comparator else one_shot_rate(args.slots, args.new_tokens)
     row = run_load(args.clients, args.seconds, args.slots, args.chunk_steps,
                    new_tokens=args.new_tokens, stagger=args.stagger,
-                   quantize=args.quantize)
+                   quantize=args.quantize, int8_matmul=args.int8_matmul)
     if args.quantize:
         row["quantize"] = args.quantize
+        row["int8_matmul"] = bool(args.int8_matmul)
     if not args.skip_comparator:
         ref_after = one_shot_rate(args.slots, args.new_tokens)
         ref = (ref_before + ref_after) / 2

@@ -12,6 +12,7 @@ the controller's function routes.
 from __future__ import annotations
 
 import io
+import os
 from pathlib import Path
 from typing import Any, List, Optional, Union
 
@@ -274,16 +275,41 @@ class _Checkpoints:
         )
 
 
+def resolve_controller_url(url: Optional[str] = None) -> str:
+    """Controller service discovery (the reference finds it through the k8s
+    Service/LoadBalancer ingress, client/util.go:18-63; here it's a resolution
+    chain). Precedence: an explicit ``url`` argument wins, then the
+    ``KUBEML_CONTROLLER_URL`` environment variable, then the process config's
+    ``controller_url`` (KUBEML_HOST/KUBEML_CONTROLLER_PORT, api.config).
+    Raises a KubeMLError naming all three sources when none resolves."""
+    if url:
+        return url
+    env = os.environ.get("KUBEML_CONTROLLER_URL", "").strip()
+    if env:
+        return env
+    try:
+        from ..api.config import get_config
+
+        cfg_url = get_config().controller_url
+    except Exception:
+        cfg_url = ""
+    if cfg_url:
+        return cfg_url
+    from ..api.errors import KubeMLError
+
+    raise KubeMLError(
+        "cannot resolve the controller URL: pass url= to KubemlClient, set "
+        "KUBEML_CONTROLLER_URL, or configure KUBEML_HOST/"
+        "KUBEML_CONTROLLER_PORT (kubeml_tpu.api.config)", 503)
+
+
 class KubemlClient:
-    """``KubemlClient(url)``; default URL from config (reference discovers the
-    controller from the k8s service, client/util.go:18-63 — here it's config)."""
+    """``KubemlClient(url)``; with no URL the client discovers the controller
+    through :func:`resolve_controller_url` (env var, then config — the
+    reference discovers it from the k8s service, client/util.go:18-63)."""
 
     def __init__(self, url: Optional[str] = None, timeout: float = 120.0):
-        if url is None:
-            from ..api.config import get_config
-
-            url = get_config().controller_url
-        self.url = url.rstrip("/")
+        self.url = resolve_controller_url(url).rstrip("/")
         self.timeout = timeout
 
     def networks(self) -> _Networks:

@@ -49,7 +49,9 @@ def _apply_platform_env() -> None:
             jax.config.update("jax_platforms", platform)
             n = os.environ.get("KUBEML_NUM_CPU_DEVICES")
             if n and platform == "cpu":
-                jax.config.update("jax_num_cpu_devices", int(n))
+                from ..utils.jax_compat import set_cpu_devices
+
+                set_cpu_devices(int(n))
         except RuntimeError:
             log.warning("backends already initialized; platform env ignored")
 

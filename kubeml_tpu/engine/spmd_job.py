@@ -34,6 +34,8 @@ import time
 from typing import Optional
 
 import jax
+
+from ..utils import jax_compat  # noqa: F401  (jax.set_mesh shim)
 import numpy as np
 
 from ..api.errors import KubeMLError

@@ -20,10 +20,13 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+
+from ..utils import jax_compat  # noqa: F401  (jax.shard_map shim)
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import dot_product_attention
 from ..parallel.ring import ring_attention
+from .layers import QuantizableDense
 
 PAD_ID = 0
 
@@ -61,8 +64,10 @@ class CausalSelfAttention(nn.Module):
         H = self.num_heads
         D = E // H
         # 2-D kernels with manual head reshape: column-sharding [E, H*D] over
-        # tp IS head-sharding (heads are the leading factor of the columns)
-        dense = lambda feats, names, name: nn.Dense(
+        # tp IS head-sharding (heads are the leading factor of the columns).
+        # QuantizableDense == nn.Dense until the serving layer hands it an
+        # int8 kernel (KUBEML_INT8_MATMUL decode, models/layers.py)
+        dense = lambda feats, names, name: QuantizableDense(
             feats, name=name,
             kernel_init=_part(names)(nn.initializers.lecun_normal()),
             use_bias=self.use_bias, dtype=self.dtype,
@@ -211,12 +216,14 @@ class GPTBlock(nn.Module):
         y = nn.LayerNorm(name="ln2", dtype=jnp.float32,
                          epsilon=self.ln_eps)(x).astype(self.dtype)
         E = x.shape[-1]
-        y = nn.Dense(E * self.mlp_ratio, name="mlp_in", dtype=self.dtype,
-                     kernel_init=_part((None, "tp"))(nn.initializers.lecun_normal()),
-                     bias_init=_part(("tp",))(nn.initializers.zeros))(y)
+        y = QuantizableDense(
+            E * self.mlp_ratio, name="mlp_in", dtype=self.dtype,
+            kernel_init=_part((None, "tp"))(nn.initializers.lecun_normal()),
+            bias_init=_part(("tp",))(nn.initializers.zeros))(y)
         y = nn.gelu(y)
-        y = nn.Dense(E, name="mlp_out", dtype=self.dtype,
-                     kernel_init=_part(("tp", None))(nn.initializers.lecun_normal()))(y)
+        y = QuantizableDense(
+            E, name="mlp_out", dtype=self.dtype,
+            kernel_init=_part(("tp", None))(nn.initializers.lecun_normal()))(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         return x + y
 
@@ -353,9 +360,9 @@ class CausalTransformer(nn.Module):
             # wants 8.4 GB f32), so the loss streams vocab chunks instead.
             # lm_head params still exist (init runs with the default False).
             return x
-        logits = nn.Dense(self.vocab_size, name="lm_head", use_bias=False,
-                          dtype=self.dtype,
-                          kernel_init=_part((None, "tp"))(nn.initializers.lecun_normal()))(x)
+        logits = QuantizableDense(
+            self.vocab_size, name="lm_head", use_bias=False, dtype=self.dtype,
+            kernel_init=_part((None, "tp"))(nn.initializers.lecun_normal()))(x)
         return logits.astype(jnp.float32)
 
 

@@ -1,0 +1,55 @@
+"""Quantization-aware building blocks shared by the model zoo.
+
+:class:`QuantizableDense` is a drop-in ``nn.Dense`` (same fields, same
+``kernel``/``bias`` param names, so checkpoints, partitioning annotations
+and every existing variables tree are byte-compatible) whose kernel may
+arrive as a :class:`~kubeml_tpu.serving.quant.QuantizedTensor` instead of
+a dense array. Dense kernels take exactly ``nn.Dense``'s math; quantized
+kernels route through ``serving.quant.quantized_dot`` — the contraction
+runs on the int8 values and the per-channel scale folds into the f32
+accumulator after, so the decode step never rebuilds a dense ``W~``
+(ops/int8_matmul.py has the bandwidth argument).
+
+The swap works because a QuantizedTensor is a pytree node whose leading
+leaf (``q``) has the kernel's exact shape: flax's param retrieval passes
+it through untouched, and the quantized tree the serving layer builds
+(serving/quant.quantize_tree) flows through ``module.apply`` like any
+variables tree. Training never sees this branch — quantization happens at
+serving time, on trees the engines already finished with.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class QuantizableDense(nn.Dense):
+    """``nn.Dense`` that also accepts an int8-quantized kernel leaf."""
+
+    @nn.compact
+    def __call__(self, inputs):
+        kernel = self.param(
+            "kernel", self.kernel_init,
+            (jnp.shape(inputs)[-1], self.features), self.param_dtype)
+        bias = (self.param("bias", self.bias_init, (self.features,),
+                           self.param_dtype)
+                if self.use_bias else None)
+        from ..serving.quant import QuantizedTensor, quantized_dot
+
+        if isinstance(kernel, QuantizedTensor):
+            # the compute dtype matches the dense branch's promotion: the
+            # module's declared dtype, else the activation dtype
+            d = self.dtype or inputs.dtype
+            y = quantized_dot(inputs.astype(d), kernel, dtype=d)
+        else:
+            inputs, kernel, bias = nn.dtypes.promote_dtype(
+                inputs, kernel, bias, dtype=self.dtype)
+            y = jax.lax.dot_general(
+                inputs, kernel, (((inputs.ndim - 1,), (0,)), ((), ())),
+                precision=self.precision)
+        if bias is not None:
+            y = y + jnp.reshape(bias.astype(y.dtype),
+                                (1,) * (y.ndim - 1) + (-1,))
+        return y

@@ -1,4 +1,6 @@
 from .attention import dot_product_attention
 from .flash_attention import flash_attention
+from .int8_matmul import int8_dot, int8_matmul
 
-__all__ = ["dot_product_attention", "flash_attention"]
+__all__ = ["dot_product_attention", "flash_attention", "int8_dot",
+           "int8_matmul"]

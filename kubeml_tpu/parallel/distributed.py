@@ -83,6 +83,10 @@ def init_distributed(
                 log.warning("TPU-pod auto-detect failed (%s); single-process", e)
         log.info("single-process mode (no KUBEML_COORDINATOR)")
         return False
+    if jax.config.jax_platforms and "cpu" in str(jax.config.jax_platforms):
+        from ..utils.jax_compat import enable_cpu_gloo
+
+        enable_cpu_gloo()
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -905,6 +905,7 @@ class ParameterServer:
             module, variables, slots=self.cfg.serving_slots,
             chunk_steps=self.cfg.serving_chunk_steps, name=model_id,
             mesh=mesh, quantize=quantize,
+            int8_matmul=self.cfg.int8_matmul,
             pipeline_depth=self.cfg.serving_pipeline,
             fetchers=self.cfg.serving_fetchers,
             pressure_sizing=self.cfg.serving_pressure_sizing)

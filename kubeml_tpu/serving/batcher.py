@@ -219,6 +219,10 @@ class _Row:
     out: List[int] = field(default_factory=list)
     done: bool = False
     canceled: bool = False  # abandoned by its waiter: free the slot ASAP
+    # slot pre-freed at dispatch time: every emission this row can produce
+    # is already in the dispatch chain, so the slot was handed to the next
+    # admission without waiting for the row's results to come back
+    drained: bool = False
 
 
 @dataclass
@@ -262,6 +266,7 @@ class BatchingDecoder:
                  chunk_steps: int = 8, bucket_min: int = 16,
                  pipeline_depth: Optional[int] = None, name: str = "decoder",
                  mesh=None, quantize: str = "",
+                 int8_matmul: Optional[bool] = None,
                  fetchers: Optional[int] = None,
                  pressure_sizing: Optional[bool] = None):
         cap = getattr(module, "max_len", None)
@@ -324,6 +329,21 @@ class BatchingDecoder:
                 "variables carry int8 QuantizedTensor leaves but quantize "
                 "is not 'int8' — a dense decode program cannot consume them")
         self.quantize = quantize
+        # NATIVE int8 matmuls (quant.quantized_dot): the QuantizedTensor
+        # leaves flow INTO module.apply and every dense projection contracts
+        # the int8 values directly (models/layers.py QuantizableDense), the
+        # per-channel scale folding into the f32 accumulator after — no
+        # dense W~ is rebuilt per step. Requires the module's dense layers
+        # to be quant-aware: the CausalTransformer family is; MoE expert
+        # stacks (3-d einsum params) are not, so they keep the dequantize
+        # path.
+        self.int8_matmul = (quantize == "int8") and bool(
+            int8_matmul if int8_matmul is not None else cfg.int8_matmul)
+        if self.int8_matmul and getattr(module, "moe_every", 0):
+            log.warning(
+                "%s: KUBEML_INT8_MATMUL does not cover MoE expert params; "
+                "falling back to in-program dequantize", name)
+            self.int8_matmul = False
         if quantize == "int8" and mesh is None and not pre_quantized:
             from .quant import quantize_tree
 
@@ -374,6 +394,10 @@ class BatchingDecoder:
         self.weight_bytes = quantized_bytes(self._variables)
         self._pending: deque = deque()
         self._slot_rows: List[Optional[_Row]] = [None] * self.slots
+        # rows whose slot was pre-freed but whose results are still in
+        # flight (see _free_drained_slots) — tracked so _fail_all reaches
+        # their waiters
+        self._draining: List[_Row] = []
         self._free = list(range(self.slots))
         self._cond = threading.Condition()
         self._closed = False
@@ -426,8 +450,11 @@ class BatchingDecoder:
     def _dense_vars(self, variables):
         """Densify int8 weights INSIDE the traced program (per scan step —
         the HBM read stays int8 and the convert+scale fuses toward the
-        matmul); identity when not quantized."""
-        if self.quantize != "int8":
+        matmul); identity when not quantized — and identity in NATIVE
+        int8-matmul mode, where the QuantizedTensor leaves flow into
+        ``module.apply`` and the quant-aware dense layers contract them
+        without any dense rebuild (quant.quantized_dot)."""
+        if self.quantize != "int8" or self.int8_matmul:
             return variables
         from .quant import dequantize_tree
 
@@ -833,6 +860,7 @@ class BatchingDecoder:
                     next_seq += 1
                     dispatched = True
                 self._evict_canceled()
+                self._free_drained_slots()
                 if (next_seq - process_seq < self.pipeline_depth
                         and (needed := self._chunk_wanted()) > 0):
                     fetch_q.put((next_seq, self._dispatch_chunk(needed)))
@@ -1045,11 +1073,43 @@ class BatchingDecoder:
                 with self._cond:
                     self._free.append(slot)
 
+    def _free_drained_slots(self) -> None:
+        """Pre-free slots whose rows' every possible emission is ALREADY in
+        the dispatch chain (``steps_ahead >= max_new - 1``): the device has
+        stopped advancing them (``remaining`` hits 0 and the live flag
+        drops inside the step scan), their tokens come back with the
+        in-flight results regardless, and token routing uses per-dispatch
+        snapshots — so the next admission may overwrite the slot wholesale
+        and the handoff is race-free. Without this, a finished request's
+        slot sat dead for up to ``depth x chunk`` steps (the fetch lag)
+        before its completion was processed and the slot re-admitted —
+        the diagnosed cost of the 256-token workload's 0.44-0.53 fraction
+        (VERDICT r5 weak-1, results/SERVING_R5_NOTE.md)."""
+        for slot, row in enumerate(self._slot_rows):
+            if row is None or row.done or row.canceled:
+                continue
+            if self._steps_ahead[slot] >= row.max_new - 1:
+                row.drained = True
+                self._slot_rows[slot] = None
+                with self._cond:
+                    self._draining.append(row)
+                    self._free.append(slot)
+
     def _complete_row(self, slot: int, row: _Row) -> None:
         row.done = True
-        self._slot_rows[slot] = None
-        with self._cond:
-            self._free.append(slot)
+        if row.drained:
+            # the slot was pre-freed at dispatch time and may already hold
+            # a newly admitted row — only retire the drain bookkeeping.
+            # Removal is BY IDENTITY: _Row/_Entry are dataclasses whose
+            # structural __eq__ recurses through the row<->entry cycle, so
+            # `in`/`.remove` against a list holding any OTHER row would
+            # blow the stack
+            with self._cond:
+                self._draining = [r for r in self._draining if r is not row]
+        else:
+            self._slot_rows[slot] = None
+            with self._cond:
+                self._free.append(slot)
         entry = row.entry
         if entry.finished():
             if self._record_outcome(entry):
@@ -1070,9 +1130,11 @@ class BatchingDecoder:
 
     def _fail_all(self, error: Exception) -> None:
         with self._cond:
-            rows = list(self._pending) + [r for r in self._slot_rows if r]
+            rows = (list(self._pending) + [r for r in self._slot_rows if r]
+                    + list(self._draining))
             self._pending.clear()
             self._slot_rows = [None] * self.slots
+            self._draining = []
             self._free = list(range(self.slots))
         failed_entries = set()
         for row in rows:

@@ -7,13 +7,19 @@ chip). The dequantize runs INSIDE the step program (int8 leaves the HBM;
 verified in the compiled HLO — the weights stay s8, nothing is hoisted
 out of the scan).
 
-Chip-measured reality (results/QUANT_R5_NOTE.md): the THROUGHPUT win is
-modest on a v5e at the 124M-774M scale — +4-11% at batch 1 (largest for
-the 774M class, whose bf16 step streams ~54% of HBM), ~0 at batch 8-16 —
-because per-op overhead and the on-chip convert+scale absorb most of the
-saved stream time. Weight-only dequant cannot reach the naive 2x; that
-needs native int8 matmuls (quantized activations on the MXU int8 path),
-which is future work, not claimed here.
+Chip-measured reality (results/QUANT_R5_NOTE.md): with the DEQUANTIZE
+path (dense bf16 rebuilt inside the step program before each matmul) the
+throughput win stalled at +4-11% at batch 1, ~0 at batch 8-16 — per-op
+overhead and the convert+scale absorbed most of the saved stream time.
+The NATIVE path closes that gap: :func:`quantized_dot` contracts the
+activations against the int8 values directly (Pallas kernel on TPU,
+``lax.dot_general`` fallback elsewhere — ops/int8_matmul.py) and folds
+the per-channel scale into the f32 accumulator AFTER the contraction, so
+no dense ``W~`` exists even as a fused intermediate. ``KUBEML_INT8_MATMUL=1``
+routes every quantized dense projection of the decode step through it
+(models/layers.py ``QuantizableDense``); the dequantize path remains the
+default and the fallback for modules the native path doesn't cover (MoE
+expert stacks).
 
 Scheme: symmetric per-output-channel int8 —
 
@@ -112,6 +118,44 @@ def dequantize_tree(variables: dict, dtype=jnp.bfloat16) -> dict:
         return leaf
 
     return jax.tree.map(one, variables, is_leaf=_is_q)
+
+
+def quantized_dot(x, qt: QuantizedTensor, *, dtype=None, impl: str = None):
+    """``x @ dequant(qt)`` WITHOUT materializing the dense weight: the
+    contraction runs on the int8 values and the per-output-channel scale
+    multiplies the f32 accumulator afterward (exact reassociation — the
+    scale is constant along the contracted axis). This is the apply hook
+    the quantized decode path routes every dense projection through
+    (models/layers.py ``QuantizableDense``).
+
+    ``impl`` selects the implementation (default: the process config's
+    ``int8_matmul_impl``): ``"auto"`` = Pallas kernel on TPU /
+    ``dot_general`` elsewhere, ``"pallas"`` = force the kernel (interpret
+    mode off-TPU — the CPU test path), ``"dot"`` = force the XLA
+    fallback. Only 2-d quantized kernels (dense projections) are
+    supported — a >2-d leaf (an MoE expert stack) has no well-defined
+    last-axis contraction here and raises instead of computing garbage.
+    ``dtype`` is the output dtype (default ``x.dtype``); accumulation is
+    f32 in every impl."""
+    if qt.q.ndim != 2:
+        raise ValueError(
+            f"quantized_dot wants a 2-d quantized kernel, got shape "
+            f"{qt.q.shape} — route >2-d leaves (expert stacks) through the "
+            f"dequantize path instead")
+    if impl is None:
+        from ..api.config import get_config
+
+        impl = get_config().int8_matmul_impl
+    if impl not in ("auto", "pallas", "dot"):
+        raise ValueError(f"unknown int8 matmul impl {impl!r} "
+                         f"(valid: 'auto', 'pallas', 'dot')")
+    from ..ops.int8_matmul import int8_dot, int8_matmul
+
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "dot"
+    if impl == "pallas":
+        return int8_matmul(x, qt.q, qt.s, out_dtype=dtype or x.dtype)
+    return int8_dot(x, qt.q, qt.s, out_dtype=dtype or x.dtype)
 
 
 INT8_TAG = "final-int8"

@@ -233,6 +233,8 @@ class ShardedCheckpointStore:
         models.gpt_pipeline.flat_serving_remap builds this plan)."""
         import jax
 
+        from ..utils.jax_compat import make_array_from_callback
+
         d = self._dir(job_id, tag)
         mpath = d / MANIFEST
         if not mpath.exists():
@@ -307,7 +309,7 @@ class ShardedCheckpointStore:
                             for s, dim in zip(index, shape))
                         return sub_assemble(src, spec, pre, index, out)
 
-                    pairs[tgt] = jax.make_array_from_callback(
+                    pairs[tgt] = make_array_from_callback(
                         shape, target, cb, dtype=dtype)
         finally:
             readers.close()

@@ -1,0 +1,101 @@
+"""Version shims for the jax baked into the runtime image.
+
+The SPMD stack is written against the current jax surface (``jax.shard_map``
+with ``check_vma``, ``jax.set_mesh``); older runtimes spell those
+``jax.experimental.shard_map.shard_map(check_rep=...)`` and use the global
+``Mesh`` context manager. Importing this module installs thin aliases onto
+``jax`` when (and only when) the names are missing, so the call sites stay
+written against the modern API. No behavior changes on a modern jax —
+``install()`` is a no-op there.
+
+Imported for its side effect by the modules that use these APIs
+(models/gpt.py, parallel/{trainer,pipeline}.py, engine/spmd_job.py); kept
+out of ``kubeml_tpu.__init__`` so control-plane-only processes still avoid
+importing jax at all.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def install() -> None:
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, /, *, mesh, in_specs, out_specs, check_vma=None,
+                      **kwargs):
+            if check_vma is not None:
+                # renamed: replication checking was "check_rep" before the
+                # varying-manual-axes (vma) generalization
+                kwargs.setdefault("check_rep", check_vma)
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kwargs)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax, "set_mesh"):
+        # the legacy spelling of an ambient mesh is the Mesh object's own
+        # context manager; set_mesh is only ever used as `with jax.set_mesh
+        # (mesh):` in this codebase, so the mesh itself is the context
+        jax.set_mesh = lambda mesh: mesh
+
+    if not hasattr(jax.lax, "pcast"):
+        # pre-vma jax: the replicated->varying annotation only exists for
+        # the vma replication checker, and every shard_map in this codebase
+        # runs with checking off (check_vma=False -> check_rep=False), so
+        # the annotation is semantically a no-op there
+        jax.lax.pcast = lambda x, axes=None, to=None: x
+
+
+def enable_cpu_gloo() -> None:
+    """Select the gloo CPU-collectives backend for multi-process CPU runs
+    (the virtual test fleet): cross-process collectives need it on jax
+    versions whose default CPU client is single-process only. Harmless
+    where gloo is already the default; call before the backend
+    initializes."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+
+
+_MAFC_HAS_DTYPE = None
+
+
+def make_array_from_callback(shape, sharding, data_callback, dtype=None):
+    """``jax.make_array_from_callback`` across versions: the ``dtype``
+    kwarg is forwarded where it exists and dropped where it doesn't (older
+    jax infers the dtype from the callback's arrays). The capability probe
+    runs once per process."""
+    global _MAFC_HAS_DTYPE
+    if _MAFC_HAS_DTYPE is None:
+        import inspect
+
+        _MAFC_HAS_DTYPE = "dtype" in inspect.signature(
+            jax.make_array_from_callback).parameters
+    if dtype is not None and _MAFC_HAS_DTYPE:
+        return jax.make_array_from_callback(shape, sharding, data_callback,
+                                            dtype=dtype)
+    return jax.make_array_from_callback(shape, sharding, data_callback)
+
+
+def set_cpu_devices(n: int) -> None:
+    """Request ``n`` virtual CPU devices across jax versions: the config
+    option where it exists, else the XLA_FLAGS spelling (which still takes
+    effect as long as no backend has initialized — call before any device
+    use)."""
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+    except AttributeError:
+        import os
+        import re
+
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       os.environ.get("XLA_FLAGS", "")).strip()
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={int(n)}"
+        ).strip()
+
+
+install()

@@ -510,6 +510,9 @@ int64_t kml_server_start(int64_t store_handle, const char* socket_path) {
   Server* raw = srv.get();
   srv->accept_thread = std::thread([raw, store]() {
     for (;;) {
+      // checked at the top so the stop path's wake-up connection (below)
+      // always lands on an exit check, whether accept() was blocked or not
+      if (raw->stopping.load()) return;
       int cfd = ::accept(raw->listen_fd, nullptr, nullptr);
       if (cfd < 0) {
         if (raw->stopping.load() || (errno != EINTR && errno != ECONNABORTED))
@@ -535,9 +538,22 @@ void kml_server_stop(int64_t h) {
     g_servers.erase(it);
   }
   srv->stopping.store(true);
+  // shutdown() does NOT wake a blocked accept() on an AF_UNIX listener on
+  // every kernel (observed hanging forever on 4.4) — a self-connection
+  // does, and the accept loop's top-of-loop stopping check turns it into
+  // a clean exit whichever state the thread was in
+  int wake = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (wake >= 0) {
+    sockaddr_un addr;
+    std::memset(&addr, 0, sizeof(addr));
+    addr.sun_family = AF_UNIX;
+    std::strncpy(addr.sun_path, srv->path.c_str(), sizeof(addr.sun_path) - 1);
+    ::connect(wake, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+    ::close(wake);
+  }
   ::shutdown(srv->listen_fd, SHUT_RDWR);
-  ::close(srv->listen_fd);
   if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  ::close(srv->listen_fd);
   ::unlink(srv->path.c_str());
 }
 

@@ -21,7 +21,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # newer jax spells the device-count knob as a config option; older
+    # versions only honor the XLA_FLAGS form set above (applied as long as
+    # the backend has not initialized yet)
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 
 import numpy as np
 import pytest

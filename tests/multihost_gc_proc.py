@@ -12,7 +12,12 @@ def main() -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 1)
+    from kubeml_tpu.utils.jax_compat import set_cpu_devices
+
+    set_cpu_devices(1)
+    from kubeml_tpu.utils.jax_compat import enable_cpu_gloo
+
+    enable_cpu_gloo()
     jax.distributed.initialize(f"127.0.0.1:{port}", 2, pid)
 
     from kubeml_tpu.parallel.distributed import get_dist_context
@@ -29,8 +34,16 @@ def main() -> int:
         return 0
 
     def present(key):
+        client = dist._client
+        if not hasattr(client, "key_value_try_get"):
+            # older jaxlib: probe with a short blocking get (ms timeout)
+            try:
+                client.blocking_key_value_get(key, 200)
+                return True
+            except Exception:
+                return False
         try:
-            return dist._client.key_value_try_get(key) is not None
+            return client.key_value_try_get(key) is not None
         except Exception as e:  # NOT_FOUND raises on this jaxlib
             if "NOT_FOUND" in str(e):
                 return False

@@ -9,8 +9,9 @@ import sys
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices",
-                  int(os.environ.get("KUBEML_TEST_LOCAL_DEVICES", "2")))
+from kubeml_tpu.utils.jax_compat import set_cpu_devices  # noqa: E402
+
+set_cpu_devices(int(os.environ.get("KUBEML_TEST_LOCAL_DEVICES", "2")))
 
 from kubeml_tpu.cli import main  # noqa: E402
 

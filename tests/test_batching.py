@@ -281,6 +281,131 @@ def test_closed_decoder_rejects(served):
         dec.submit(GenerateRequest(prompts=[[1, 2]], max_new_tokens=2))
 
 
+# --- dead-row drain: slots pre-free at dispatch time (VERDICT r5 weak-1) ---
+
+def test_drain_mixed_lengths_parity_and_clean_engine_state(served):
+    """Mixed-length rows through few slots with a deep pipeline exercise
+    the drain handoff (a slot freed while its row's results are still in
+    flight, then immediately re-admitted): every row stays token-identical
+    to one-shot and the drain bookkeeping retires cleanly."""
+    m, variables = served
+    dec = BatchingDecoder(m, variables, slots=2, chunk_steps=4,
+                          pipeline_depth=6, fetchers=2)
+    try:
+        rng = np.random.default_rng(3)
+        lens = [3, 5, 8, 4, 6, 9, 7, 10]
+        max_news = [1, 3, 6, 9, 2, 5, 8, 4]
+        prompts = [rng.integers(1, VOCAB, size=(1, l)).astype(np.int32)
+                   for l in lens]
+        refs = [one_shot(m, variables, p, n)[0][0].tolist()
+                for p, n in zip(prompts, max_news)]
+        entries = [dec.submit(GenerateRequest(prompts=p.tolist(),
+                                              max_new_tokens=n))
+                   for p, n in zip(prompts, max_news)]
+        for e, ref in zip(entries, refs):
+            assert dec.wait(e, timeout=600)["tokens"][0] == ref
+        with dec._cond:
+            assert dec._draining == []
+            assert sorted(dec._free) == [0, 1]
+            assert all(r is None for r in dec._slot_rows)
+    finally:
+        dec.close()
+
+
+def test_drain_prefrees_slot_without_double_free(served):
+    """White-box: once a row's remaining emissions are all in the dispatch
+    chain, its slot pre-frees (available for the next admission) and the
+    row's later completion must NOT free the slot a second time or clobber
+    the new occupant."""
+    from kubeml_tpu.serving.batcher import _Entry, _Row
+
+    m, variables = served
+    dec = BatchingDecoder(m, variables, slots=2, chunk_steps=4)
+
+    def make_row(max_new):
+        rows = []
+        entry = _Entry(rows=rows, max_new=max_new)
+        row = _Row(entry=entry, index=0, prompt=np.array([1], np.int32),
+                   max_new=max_new, temp=0.0, topk=0, eos=-1,
+                   key=np.zeros(2, np.uint32))
+        rows.append(row)
+        return row
+
+    row = make_row(4)
+    dec._slot_rows[0] = row
+    dec._steps_ahead[0] = 3  # == max_new - 1: everything is in flight
+    dec._free = [1]
+    dec._free_drained_slots()
+    assert row.drained and dec._slot_rows[0] is None
+    assert sorted(dec._free) == [0, 1] and dec._draining == [row]
+
+    # the freed slot gets a new occupant; the old row's completion arrives
+    newcomer = make_row(8)
+    dec._slot_rows[0] = newcomer
+    dec._free = [1]
+    dec._complete_row(0, row)
+    assert row.done and row.entry.done_evt.is_set()
+    assert dec._slot_rows[0] is newcomer  # not clobbered
+    assert dec._free == [1]               # not double-freed
+    assert dec._draining == []
+
+    # a live (undrained) row below the threshold is untouched
+    assert not newcomer.drained
+    dec._steps_ahead[0] = 3  # < max_new - 1
+    dec._free_drained_slots()
+    assert dec._slot_rows[0] is newcomer and not newcomer.drained
+    dec.close()
+
+
+def test_drain_completion_is_identity_based(served):
+    """Two rows draining at once, the NON-first completing first: the
+    bookkeeping must remove by identity — _Row/_Entry structural equality
+    recurses through the row<->entry cycle, so an `in`/`.remove` against a
+    list holding any other row would blow the stack (RecursionError)."""
+    from kubeml_tpu.serving.batcher import _Entry, _Row
+
+    m, variables = served
+    dec = BatchingDecoder(m, variables, slots=2, chunk_steps=4)
+
+    def make_row(max_new):
+        rows = []
+        entry = _Entry(rows=rows, max_new=max_new)
+        row = _Row(entry=entry, index=0, prompt=np.array([1], np.int32),
+                   max_new=max_new, temp=0.0, topk=0, eos=-1,
+                   key=np.zeros(2, np.uint32), drained=True)
+        rows.append(row)
+        return row
+
+    first, second = make_row(4), make_row(4)
+    dec._draining = [first, second]
+    dec._complete_row(1, second)  # must not compare second against first
+    assert second.done and dec._draining == [first]
+    dec._complete_row(0, first)
+    assert dec._draining == []
+    dec.close()
+
+
+def test_fail_all_reaches_draining_rows(served):
+    """A loop failure must fail waiters whose slots were already pre-freed
+    — they are no longer in _slot_rows, only in _draining."""
+    from kubeml_tpu.serving.batcher import _Entry, _Row
+
+    m, variables = served
+    dec = BatchingDecoder(m, variables, slots=2, chunk_steps=4)
+    rows = []
+    entry = _Entry(rows=rows, max_new=4)
+    row = _Row(entry=entry, index=0, prompt=np.array([1], np.int32),
+               max_new=4, temp=0.0, topk=0, eos=-1,
+               key=np.zeros(2, np.uint32), drained=True)
+    rows.append(row)
+    dec._draining.append(row)
+    boom = RuntimeError("device fault")
+    dec._fail_all(boom)
+    assert entry.error is boom and entry.done_evt.is_set()
+    assert dec._draining == []
+    dec.close()
+
+
 # --- wire-type validation added with the batcher (ADVICE round 3) ---
 
 def test_generate_request_rejects_bool_knobs():

@@ -95,6 +95,58 @@ def test_int8_decoder_matches_oneshot_on_dequantized_weights(served):
         dec.close()
 
 
+def test_native_int8_matmul_token_parity(served, monkeypatch):
+    """KUBEML_INT8_MATMUL=1 (acceptance criterion): QuantizedTensor leaves
+    flow INTO module.apply — no dense W~ in the step program — and greedy
+    decode through the batcher stays token-identical to the one-shot oracle
+    on the dequantized tree, for both the Pallas interpret kernel and the
+    dot_general fallback."""
+    from kubeml_tpu.api.config import Config, get_config, set_config
+
+    m, variables = served
+    qd = dequantize_tree(quantize_tree(variables), jnp.float32)
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, VOCAB, size=(1, int(l))).astype(np.int32)
+               for l in (3, 6, 10)]
+    refs = [np.asarray(generate(m, qd, p, max_new_tokens=8).tokens)
+            for p in prompts]
+    prev = get_config()
+    monkeypatch.setenv("KUBEML_INT8_MATMUL", "1")
+    try:
+        for impl in ("dot", "pallas"):
+            monkeypatch.setenv("KUBEML_INT8_MATMUL_IMPL", impl)
+            set_config(Config())
+            dec = BatchingDecoder(m, variables, slots=3, chunk_steps=4,
+                                  quantize="int8")
+            try:
+                assert dec.int8_matmul  # the env knob reached the engine
+                entries = [dec.submit(GenerateRequest(
+                    prompts=p.tolist(), max_new_tokens=8)) for p in prompts]
+                for e, ref in zip(entries, refs):
+                    out = dec.wait(e, timeout=300)
+                    assert out["tokens"][0] == ref[0].tolist(), impl
+                # the byte accounting is untouched: weights stay s8
+                assert dec.weight_bytes < quantized_bytes(variables) / 2
+            finally:
+                dec.close()
+    finally:
+        set_config(prev)
+
+
+def test_native_int8_matmul_moe_falls_back(served, monkeypatch):
+    """Modules the quant-aware dense layers don't cover (MoE expert
+    stacks) must keep the dequantize path, loudly."""
+    m = CausalTransformer(vocab_size=VOCAB, max_len=64, embed_dim=64,
+                          depth=2, num_heads=4, moe_every=2)
+    variables = m.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    dec = BatchingDecoder(m, variables, slots=2, chunk_steps=4,
+                          quantize="int8", int8_matmul=True)
+    try:
+        assert dec.int8_matmul is False
+    finally:
+        dec.close()
+
+
 def test_quality_report_bounds(served):
     m, variables = served
     rng = np.random.default_rng(0)
@@ -207,6 +259,96 @@ def test_storage_tree_roundtrip(served):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     # plain trees pass through untouched
     assert not is_quantized_storage({"params": {"w": np.ones(3)}})
+
+
+def _assert_trees_bit_exact(a, b):
+    """Same structure, same dtypes, byte-identical leaf values."""
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype
+        np.testing.assert_array_equal(x, y)
+
+
+def test_quantized_sharded_checkpoint_roundtrip(served, tmp_path):
+    """int8 leaves through the sharded store's host-assembly restore: the
+    storage-form tree comes back with q int8 / s f32 BIT-EXACT — a lossy
+    hop here would silently corrupt every final-int8 serve."""
+    from kubeml_tpu.serving.quant import from_storage_tree, to_storage_tree
+    from kubeml_tpu.storage.sharded_checkpoint import ShardedCheckpointStore
+
+    _, variables = served
+    q = quantize_tree(variables)
+    store = ShardedCheckpointStore(root=tmp_path)
+    store.save("qjob", jax.tree.map(np.asarray, to_storage_tree(q)),
+               epoch=1, tag="final-int8")
+    back = from_storage_tree(store.restore("qjob", "final-int8").variables)
+    kernel = back["params"]["block_0"]["mlp_in"]["kernel"]
+    assert isinstance(kernel, QuantizedTensor)
+    assert kernel.q.dtype == np.int8 and kernel.s.dtype == np.float32
+    _assert_trees_bit_exact(q, back)
+
+
+def test_quantized_sharded_checkpoint_slicewise_restore_on_mesh(served,
+                                                                tmp_path):
+    """The SLICE-WISE path: restore the int8 storage tree straight onto a
+    tp=2 serving mesh through storage_shardings — QuantizedTensor leaves
+    land sharded (q with its kernel's spec, s with its channel axis) and
+    stay bit-exact against the host tree."""
+    from jax.sharding import PartitionSpec as P
+
+    from kubeml_tpu.parallel.mesh import make_mesh
+    from kubeml_tpu.serving.batcher import storage_shardings
+    from kubeml_tpu.serving.quant import from_storage_tree, to_storage_tree
+    from kubeml_tpu.storage.sharded_checkpoint import ShardedCheckpointStore
+
+    m, variables = served
+    q = quantize_tree(variables)
+    store = ShardedCheckpointStore(root=tmp_path)
+    store.save("qjob", jax.tree.map(np.asarray, to_storage_tree(q)),
+               epoch=1, tag="final-int8")
+    mesh = make_mesh(shape={"tp": 2}, devices=jax.devices()[:2])
+    manifest = store.read_manifest("qjob", "final-int8")
+    sh = storage_shardings(manifest["leaves"], m, mesh)
+    back = from_storage_tree(store.restore("qjob", "final-int8",
+                                           shardings=sh).variables)
+    kernel = back["params"]["block_0"]["mlp_in"]["kernel"]
+    assert isinstance(kernel, QuantizedTensor)
+    assert str(kernel.q.dtype) == "int8"
+    assert kernel.q.sharding.spec == P(None, "tp")
+    assert kernel.s.sharding.spec == P(None, "tp")
+    _assert_trees_bit_exact(q, back)
+
+
+def test_quantized_tree_native_weights_roundtrip(served):
+    """int8 leaves through the native TensorStore publish/fetch seqlock
+    (the standalone-runner live-serving channel): bit-exact q/s."""
+    from kubeml_tpu.native.weights import fetch_variables, publish_variables
+    from kubeml_tpu.serving.quant import from_storage_tree, to_storage_tree
+
+    class MemKV:
+        def __init__(self):
+            self.d = {}
+
+        def set(self, k, v):
+            self.d[k] = np.asarray(v)
+
+        def get(self, k):
+            return self.d.get(k)
+
+    _, variables = served
+    q = quantize_tree(variables)
+    kv = MemKV()
+    publish_variables(kv, jax.tree.map(np.asarray, to_storage_tree(q)),
+                      version=3)
+    tree, version = fetch_variables(kv)
+    assert version == 3
+    back = from_storage_tree(tree)
+    kernel = back["params"]["block_0"]["mlp_in"]["kernel"]
+    assert isinstance(kernel, QuantizedTensor)
+    assert kernel.q.dtype == np.int8 and kernel.s.dtype == np.float32
+    _assert_trees_bit_exact(q, back)
 
 
 @pytest.mark.slow
