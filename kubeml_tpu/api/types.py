@@ -323,6 +323,10 @@ class TrainTask(_JsonMixin):
     state: JobState = field(default_factory=JobState)
     status: str = JobStateEnum.QUEUED
     started_at: float = field(default_factory=time.time)
+    # W3C traceparent of the submitting request: the scheduler queue and the
+    # PS hand-off are not HTTP hops, so the trace context rides the task
+    # itself and the job's spans stitch under the original /train request
+    trace_parent: str = ""
 
     def __post_init__(self):
         if isinstance(self.parameters, dict):
@@ -345,6 +349,14 @@ class MetricUpdate(_JsonMixin):
     # of attempted top-k assignments dropped by the capacity limit);
     # -1 = the model has no MoE layers (gauge omitted)
     moe_overflow: float = -1.0
+    # latency-histogram feeds (ps/metrics.py): per-round wall times of this
+    # epoch (the function/update latency analog of the reference's per-
+    # invocation timing) and the epoch-end blocking merge/loss sync. The
+    # K-AVG merge itself is fused on-chip into the round program, so the
+    # host-observable merge cost is the epoch-end fetch that waits on it;
+    # -1 = not measured (e.g. an engine that doesn't time it)
+    round_seconds: List[float] = field(default_factory=list)
+    merge_seconds: float = -1.0
 
 
 @dataclass

@@ -6,7 +6,9 @@ Reference commands (reference: ml/pkg/kubeml-cli/cmd/root.go:7-17):
 ``function create|delete|list`` (cmd/function.go), ``dataset create|delete|list``
 (cmd/dataset.go), ``task list|stop`` (cmd/task.go), ``history get|delete|list|
 prune`` (cmd/history.go), ``logs`` (cmd/log.go). Extra: ``start`` boots the
-all-in-one local cluster (no Helm/K8s here — the TPU VM is the cluster).
+all-in-one local cluster (no Helm/K8s here — the TPU VM is the cluster), and
+``trace <task-id>`` fetches a task's merged distributed trace as one
+Chrome/Perfetto file (docs/design.md §11).
 
 Run as ``python -m kubeml_tpu.cli <command>``.
 """
@@ -85,7 +87,14 @@ def cmd_train(args) -> int:
             mesh_shape=mesh_shape,
         ),
     )
-    job_id = _client(args).networks().train(req)
+    # with KUBEML_TRACE set the CLI contributes the trace ROOT: the submit
+    # hop's traceparent makes every downstream span (controller, scheduler,
+    # PS, worker) a child of this invocation
+    from .utils.tracing import get_tracer
+
+    with get_tracer().span("cli.train", service="cli",
+                           function=args.function, dataset=args.dataset):
+        job_id = _client(args).networks().train(req)
     print(job_id)
     return 0
 
@@ -379,6 +388,37 @@ def cmd_logs(args) -> int:
     return 0
 
 
+# --- trace: fetch a task's merged distributed trace ---
+
+
+def cmd_trace(args) -> int:
+    """``kubeml trace <task-id> [-o out.json]``: fetch the merged span tree
+    of a (completed) train task — spans from every process that touched it,
+    one trace_id — and write a single Chrome/Perfetto trace file."""
+    from .utils.tracing import merge_chrome_trace
+
+    data = _client(args).tasks().trace(args.id)
+    spans = data.get("spans", [])
+    chrome = merge_chrome_trace(spans)
+    services = sorted({s.get("service") or "?" for s in spans})
+    summary = (f"{len(spans)} spans from {len(services)} processes "
+               f"({', '.join(services)}), trace ids {data.get('trace_ids')}")
+    if args.out:
+        from pathlib import Path
+
+        out = Path(args.out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(chrome))
+        print(f"{out}: {summary}")
+    else:
+        _print(chrome)
+        print(summary, file=sys.stderr)  # stdout stays pipeable JSON
+    if data.get("dropped"):
+        print(f"warning: {data['dropped']} spans dropped at the collector "
+              f"cap", file=sys.stderr)
+    return 0
+
+
 # --- start: boot the all-in-one cluster ---
 
 
@@ -391,12 +431,19 @@ def cmd_start(args) -> int:
     log_dir.mkdir(parents=True, exist_ok=True)
     logging.basicConfig(
         level=logging.DEBUG if cfg.debug else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        format="%(asctime)s %(name)s %(levelname)s "
+               "[trace=%(trace_id)s task=%(task_id)s] %(message)s",
         handlers=[
             logging.StreamHandler(),
             logging.FileHandler(log_dir / "kubeml.log"),
         ],
     )
+    # log <-> trace correlation: every record carries the thread's bound
+    # trace/task ids ("-" outside a request/job context)
+    from .utils.tracing import add_log_context, get_tracer
+
+    add_log_context()
+    get_tracer().service = "kubeml"
     import signal
     import threading
 
@@ -571,6 +618,14 @@ def build_parser() -> argparse.ArgumentParser:
     cd = csub.add_parser("delete")
     cd.add_argument("--id", required=True)
     c.set_defaults(fn=cmd_checkpoint)
+
+    tr = sub.add_parser("trace",
+                        help="fetch a task's merged distributed trace "
+                             "(Chrome/Perfetto JSON)")
+    tr.add_argument("id", help="task/job id")
+    tr.add_argument("--out", "-o", default=None,
+                    help="write the Chrome trace here (default: stdout)")
+    tr.set_defaults(fn=cmd_trace)
 
     lg = sub.add_parser("logs", help="show cluster logs")
     lg.add_argument("--id", default=None, help="filter by job id")

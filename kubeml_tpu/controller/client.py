@@ -17,9 +17,9 @@ from pathlib import Path
 from typing import Any, List, Optional, Union
 
 import numpy as np
-import requests
 
 from ..api.errors import error_from_envelope
+from ..utils import traced_http as requests  # traceparent-stamped requests
 from ..api.types import (DatasetSummary, GenerateRequest, History,
                          InferRequest, TrainRequest, TrainTask)
 
@@ -176,6 +176,14 @@ class _Tasks:
 
     def prune(self) -> int:
         return _check(requests.delete(f"{self.c.url}/tasks", timeout=self.c.timeout))["pruned"]
+
+    def trace(self, job_id: str) -> dict:
+        """The merged distributed trace of a (completed) task:
+        ``{"task_id", "trace_ids", "spans": [span dicts]}`` — render with
+        ``kubeml_tpu.utils.tracing.merge_chrome_trace``."""
+        return _check(
+            requests.get(f"{self.c.url}/tasks/{job_id}/trace", timeout=self.c.timeout)
+        )
 
 
 class _Histories:

@@ -57,6 +57,7 @@ class Controller:
         router.route("GET", "/tasks", self._tasks)
         router.route("DELETE", "/tasks", self._task_prune)
         router.route("DELETE", "/tasks/{id}", self._task_stop)
+        router.route("GET", "/tasks/{id}/trace", self._task_trace)
         router.route("GET", "/history", self._history_list)
         router.route("GET", "/history/{id}", self._history_get)
         router.route("DELETE", "/history/{id}", self._history_delete)
@@ -137,6 +138,17 @@ class Controller:
 
     def _task_prune(self, req: Request):
         return {"pruned": self.ps.prune_tasks()}
+
+    def _task_trace(self, req: Request):
+        """The task's merged distributed trace (spans from every process that
+        touched it, collected at the PS; ``kubeml trace`` renders the result
+        as one Chrome/Perfetto file)."""
+        trace = self.ps.get_trace(req.params["id"])
+        if not trace.get("spans"):
+            raise KubeMLError(
+                f"no trace recorded for task {req.params['id']!r} "
+                f"(is KUBEML_TRACE set on the cluster?)", 404)
+        return trace
 
     # --- history (reference historyApi.go:14-111) ---
 

@@ -46,10 +46,15 @@ def run_follower(config=None) -> int:
     from ..storage.store import ShardStore
     from . import job_class_for
 
+    from ..utils import tracing
+
     cfg = config or get_config()
     dist = get_dist_context()
     if dist.is_leader:
         raise RuntimeError("run_follower must not run on process 0")
+    # this process is one worker rank of every job it follows: its spans
+    # label per-rank in the merged trace and deliver to the leader's PS
+    tracing.get_tracer().service = f"worker-{dist.rank}"
     registry = FunctionRegistry(config=cfg)
     store = ShardStore(config=cfg)
     history_store = HistoryStore(config=cfg)
@@ -115,7 +120,10 @@ def run_follower(config=None) -> int:
             job, cfg.function_timeout,
             f"dist job {task.job_id} (follower {dist.rank})")
         try:
-            job.train()
+            with tracing.use_context(
+                    tracing.parse_traceparent(task.trace_parent)), \
+                    tracing.bind_task(task.job_id):
+                job.train()
             log.info("follower %d: job %s done", dist.rank, task.job_id)
         except KubeMLError as e:
             from .failures import is_transient_accelerator_error
@@ -128,6 +136,8 @@ def run_follower(config=None) -> int:
             log.error("follower %d: job %s failed: %s", dist.rank, task.job_id, e)
         finally:
             guard.set()
+            # deliver this rank's spans to the leader's PS span collector
+            tracing.post_task_spans(cfg.ps_url, task.job_id)
         jobs += 1
 
 

@@ -122,6 +122,10 @@ class TrainJob:
         self.chaos = chaos
         self.health = WorkerHealth(threshold=health_threshold)
         self.tracer = get_tracer()
+        # per-epoch latency-histogram feeds (reset in _train_epoch, pushed
+        # with the epoch's MetricUpdate)
+        self._last_round_times: list = []
+        self._last_merge_s = -1.0
 
         self.history = History(id=job_id, task={"request": request.to_dict()})
         self.history.notes.extend(self._pending_notes)
@@ -195,7 +199,8 @@ class TrainJob:
                     break
                 t0 = time.time()
                 used_parallelism = self.parallelism
-                with self.tracer.span("job.epoch", job=self.job_id, epoch=epoch,
+                with self.tracer.span("job.epoch", service="worker",
+                                      job=self.job_id, epoch=epoch,
                                       parallelism=self.parallelism):
                     train_loss = self._train_epoch(epoch, handle, dataset)
                 elapsed = time.time() - t0
@@ -392,6 +397,9 @@ class TrainJob:
         rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), epoch + 1)
         losses = []
         skipped = 0
+        # latency-histogram feeds, reset per epoch (pushed with MetricUpdate)
+        self._last_round_times = []
+        self._last_merge_s = -1.0
         # double-buffered staging: each round's slabs are device_put one round
         # ahead, so the host->HBM transfer of round i+1 overlaps round i's
         # compute (stage_round never blocks; parallelism is fixed within an
@@ -432,11 +440,17 @@ class TrainJob:
                     log.warning("%s: round %d skipped — no healthy data-bearing worker",
                                 self.job_id, rb.round_index)
                     continue
-            with self.tracer.span("job.round", job=self.job_id, epoch=epoch,
+            t_round = time.time()
+            with self.tracer.span("job.round", service="worker",
+                                  job=self.job_id, epoch=epoch,
                                   round=rb.round_index):
                 loss = self._run_round(rb, rng, worker_mask, epoch, staged=rb_staged)
             if loss is None:  # stop requested during retry backoff
                 break
+            # histogram feed (ps/metrics.py): per-round host wall time — the
+            # function/update-latency analog of the reference's per-invocation
+            # timing (dispatch is async; sync stalls land on the epoch fetch)
+            self._last_round_times.append(time.time() - t_round)
             self.heartbeat = time.time()  # round dispatched: job is alive
             self.heartbeat_cold = False   # cold-start compile is behind us
             if not losses:
@@ -466,7 +480,13 @@ class TrainJob:
         # weights were reassigned to the poisoned outputs — so translate the
         # fault into an actionable error instead of a bare RPC traceback.
         try:
-            return float(np.mean([float(l) for l in losses]))
+            t_merge = time.time()
+            mean_loss = float(np.mean([float(l) for l in losses]))
+            # the K-AVG merge is fused on-chip into the round program; this
+            # blocking fetch is where the host waits on it, so its wall time
+            # is the observable merge cost (kubeml_job_merge_seconds)
+            self._last_merge_s = time.time() - t_merge
+            return mean_loss
         except KubeMLError:
             raise
         except Exception as e:
@@ -614,7 +634,8 @@ class TrainJob:
                 self.heartbeat = time.time()
                 yield rb
 
-        with self.tracer.span("job.validate", job=self.job_id):
+        with self.tracer.span("job.validate", service="worker",
+                              job=self.job_id):
             acc, loss = self.trainer.evaluate_rounds(self._stacked_vars,
                                                      stamping(loader))
         dataset.set_mode(True)
@@ -647,7 +668,8 @@ class TrainJob:
     def _save_checkpoint(self, epoch: int) -> None:
         self.heartbeat = time.time()  # checkpoint phase: no rounds stamping
         try:
-            with self.tracer.span("job.checkpoint", job=self.job_id, epoch=epoch):
+            with self.tracer.span("job.checkpoint", service="worker",
+                                  job=self.job_id, epoch=epoch):
                 # the device->host copy is synchronous (it must snapshot THIS
                 # epoch's weights — and is a collective all processes join in
                 # dist mode), but the npz write + retention prune run on a
@@ -730,6 +752,8 @@ class TrainJob:
                     accuracy=float(acc_pct) if acc_pct is not None else 0.0,
                     parallelism=parallelism,
                     epoch_duration=float(elapsed),
+                    round_seconds=[float(t) for t in self._last_round_times],
+                    merge_seconds=float(self._last_merge_s),
                 )
             )
         except Exception:

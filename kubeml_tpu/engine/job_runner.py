@@ -67,6 +67,9 @@ class JobRunner:
         self.job_id = job_id
         self.job = None
         self.thread: Optional[threading.Thread] = None
+        # the /start request's trace context, re-bound to the training thread
+        # so the job's spans stitch under the submitting request's trace
+        self._trace_ctx = None
         self.status = "starting"
         self.exit_error: Optional[str] = None
         self.done = threading.Event()
@@ -133,6 +136,10 @@ class JobRunner:
             if self.job is not None:
                 raise KubeMLError(f"job {self.job_id} already started", 400)
             task = TrainTask.parse_request(req.json() or {})
+            from ..utils import tracing
+
+            self._trace_ctx = (tracing.current_context()
+                               or tracing.parse_traceparent(task.trace_parent))
             request = task.parameters
             model = FunctionRegistry(config=self.cfg).load(request.function_name)
             model._set_params(lr=request.lr, batch_size=request.batch_size,
@@ -172,6 +179,7 @@ class JobRunner:
         # teardown releases the accelerator client, the PS's runner-death
         # monitor marks the job failed and frees the slot, and the next job
         # gets a clean device in a fresh runner.
+        from ..utils import tracing
         from ..utils.watchdog import arm_stall_watchdog
 
         import time as _time
@@ -184,7 +192,9 @@ class JobRunner:
                       "marks the job FAILED and frees the slot; it is NOT "
                       "resumed"))
         try:
-            self.job.train()
+            with tracing.use_context(self._trace_ctx), \
+                    tracing.bind_task(self.job_id):
+                self.job.train()
             self.status = "stopped" if self.job.stop_event.is_set() else "finished"
         except Exception as e:
             self.status = "failed"
@@ -193,6 +203,9 @@ class JobRunner:
         finally:
             guard.set()
             self._notify_ps_finished()
+            # deliver this process's spans to the PS span collector BEFORE
+            # signaling done — the parent may reap us right after
+            tracing.post_task_spans(self.cfg.ps_url, self.job_id)
             self.done.set()
 
     def _update(self, req):
@@ -254,14 +267,16 @@ class JobRunner:
     def _epoch_end(self, state) -> int:
         """Reference loop shape: job -> scheduler /job; answer arrives on /update
         (via PS). Timeout keeps a dead scheduler from wedging training."""
-        import requests
-
         from ..api.types import TrainTask
+        from ..utils import traced_http as requests
+        from ..utils import tracing
 
         box = [threading.Event(), 0]
         with self._lock:
             self._update_box = box
-        task = TrainTask(job_id=self.job_id, parameters=self.job.request, state=state)
+        ctx = tracing.current_context() or self._trace_ctx
+        task = TrainTask(job_id=self.job_id, parameters=self.job.request, state=state,
+                         trace_parent=ctx.traceparent() if ctx else "")
         try:
             requests.post(f"{self.cfg.scheduler_url}/job", json=task.to_dict(), timeout=10)
         except requests.RequestException as e:
@@ -281,7 +296,7 @@ class JobRunner:
                     self._update_box = None  # late answers hit the warning path
 
     def _push_metrics(self, update) -> None:
-        import requests
+        from ..utils import traced_http as requests
 
         try:
             requests.post(f"{self.cfg.ps_url}/metrics/{self.job_id}",
@@ -290,7 +305,7 @@ class JobRunner:
             log.debug("job %s: metrics push failed (PS down?)", self.job_id)
 
     def _notify_ps_finished(self) -> None:
-        import requests
+        from ..utils import traced_http as requests
 
         try:
             requests.post(
@@ -342,8 +357,15 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
-        format=f"%(asctime)s job-{args.job_id} %(name)s %(levelname)s %(message)s",
+        format=f"%(asctime)s job-{args.job_id} %(name)s %(levelname)s "
+               f"[trace=%(trace_id)s task=%(task_id)s] %(message)s",
     )
+    from ..utils import tracing
+
+    # this process IS the worker pod: its spans label as "worker" in the
+    # merged trace, its log lines carry the bound trace/task ids
+    tracing.get_tracer().service = "worker"
+    tracing.add_log_context()
     _apply_platform_env()
     from ..api.config import get_config
 

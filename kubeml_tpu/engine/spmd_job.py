@@ -207,7 +207,8 @@ class SPMDJob:
                     break
                 t0 = time.time()
                 losses = []
-                with self.tracer.span("job.epoch", job=self.job_id, epoch=epoch,
+                with self.tracer.span("job.epoch", service="worker",
+                                      job=self.job_id, epoch=epoch,
                                       engine="spmd"):
                     for i, batch in enumerate(self._token_batches("train", req.batch_size)):
                         if self.stop_event.is_set() and not dist_multi:
@@ -367,7 +368,8 @@ class SPMDJob:
         # eval BATCH hung inside a traced program still trips the monitor)
         self.heartbeat = time.time()
         losses, accs = [], []
-        with self.tracer.span("job.validate", job=self.job_id, engine="spmd"):
+        with self.tracer.span("job.validate", service="worker",
+                              job=self.job_id, engine="spmd"):
             for batch in self._token_batches("test", self.request.batch_size):
                 l, a = self.trainer.eval_metrics(batch)  # enters the mesh itself
                 self.heartbeat = time.time()
@@ -486,7 +488,8 @@ class SPMDJob:
         # process run ahead while its peers sit in the gather — the hang the
         # follower's failure semantics exist to prevent. Only the disk write
         # is non-fatal.
-        with self.tracer.span("job.checkpoint", job=self.job_id, epoch=epoch):
+        with self.tracer.span("job.checkpoint", service="worker",
+                              job=self.job_id, epoch=epoch):
             variables = self._host_params()
             if not self._leader:
                 return
@@ -516,7 +519,8 @@ class SPMDJob:
         end-of-job export passes FINAL_TAG."""
         import flax.linen as nn
 
-        with self.tracer.span("job.checkpoint", job=self.job_id, epoch=epoch,
+        with self.tracer.span("job.checkpoint", service="worker",
+                              job=self.job_id, epoch=epoch,
                               sharded=True):
             barrier = (self.dist.barrier
                        if self.dist is not None and self.dist.size > 1 else None)

@@ -5,12 +5,21 @@ Same metric names and label scheme as the reference's parameter-server gauges
 running-jobs gauge labeled ``type``; updated each epoch/validation and cleared
 when the job finishes (metrics.go:90-133). Rendered in the Prometheus text
 exposition format on ``/metrics`` with no client-library dependency.
+
+Beyond the reference's gauges, hot-path timings get real distributions: a
+small :class:`Histogram` primitive (cumulative ``_bucket``/``_sum``/``_count``
+series) records per-round function latency, epoch-end merge time, and epoch
+wall time per job — the gauges only ever showed the LAST epoch's value, which
+flattens exactly the tail behavior latency attribution needs. The serving
+runtime feeds the same primitive (serving/stats.py: TTFT, request latency,
+decode-step time), rendered here next to the training series.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, Tuple
+from bisect import bisect_left
+from typing import Dict, List, Sequence, Tuple
 
 from ..api.types import MetricUpdate
 
@@ -25,6 +34,70 @@ GAUGES = {
     "kubeml_job_moe_overflow": "MoE expert-capacity overflow rate",
 }
 RUNNING = "kubeml_job_running_total"
+
+# default bucket edges (seconds): spans sub-10ms decode steps through
+# multi-minute epochs; +Inf is implicit
+LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+
+class Histogram:
+    """Minimal Prometheus histogram: fixed bucket edges, cumulative counts,
+    ``observe`` is O(log buckets) under the caller's locking discipline (the
+    registry wraps access in its own lock; serving stats in theirs)."""
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Sequence[float] = LATENCY_BUCKETS):
+        self.buckets = tuple(float(b) for b in buckets)
+        self.counts = [0] * len(self.buckets)  # per-edge (non-cumulative)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.sum += v
+        self.count += 1
+        idx = bisect_left(self.buckets, v)
+        if idx < len(self.counts):
+            self.counts[idx] += 1
+
+    def cumulative(self) -> List[Tuple[float, int]]:
+        """[(le, cumulative count)] per edge; +Inf is ``self.count``."""
+        out, total = [], 0
+        for edge, c in zip(self.buckets, self.counts):
+            total += c
+            out.append((edge, total))
+        return out
+
+    @staticmethod
+    def _fmt_le(edge: float) -> str:
+        s = f"{edge:g}"
+        return s
+
+    def render(self, name: str, label: str = "", value: str = "") -> List[str]:
+        """Exposition lines for one labeled series (no HELP/TYPE headers)."""
+        return self.render_snapshot(name, self.snapshot(), label, value)
+
+    def snapshot(self) -> dict:
+        """Plain-data form for cross-thread/process transport (serving
+        telemetry snapshots carry these to the registry's renderer)."""
+        return {"buckets": [[e, c] for e, c in self.cumulative()],
+                "sum": self.sum, "count": self.count}
+
+    @staticmethod
+    def render_snapshot(name: str, snap: dict, label: str = "",
+                        value: str = "") -> List[str]:
+        sel = f'{label}="{value}",' if label else ""
+        bare = f'{{{sel[:-1]}}}' if label else ""
+        lines = [
+            f'{name}_bucket{{{sel}le="{Histogram._fmt_le(float(edge))}"}} {int(c)}'
+            for edge, c in snap.get("buckets", ())
+        ]
+        lines.append(f'{name}_bucket{{{sel}le="+Inf"}} {int(snap.get("count", 0))}')
+        lines.append(f'{name}_sum{bare} {snap.get("sum", 0.0)}')
+        lines.append(f'{name}_count{bare} {int(snap.get("count", 0))}')
+        return lines
 
 # serving-runtime series (continuous batcher, serving/stats.py): per-model,
 # labeled ``model``. Counters end in _total; the rest are gauges.
@@ -48,6 +121,31 @@ SERVING_COUNTERS = {
     "kubeml_serving_chunks_total": ("chunks",
                                     "Decode chunk programs dispatched"),
 }
+# per-job latency histograms (no reference counterpart — the gauges above
+# keep only the LAST epoch's value). Fed from MetricUpdate; series OUTLIVE
+# the job (histograms are cumulative; a finished job's distribution is the
+# artifact), bounded by MAX_HISTOGRAM_JOBS oldest-first eviction.
+HISTOGRAMS = {
+    "kubeml_job_epoch_seconds": "Epoch wall-time distribution of a train job",
+    "kubeml_job_round_seconds": (
+        "Per-sync-round wall time (the function/update latency)"),
+    "kubeml_job_merge_seconds": (
+        "Epoch-end merge/loss sync wall time (the on-chip K-AVG merge is "
+        "awaited here)"),
+}
+MAX_HISTOGRAM_JOBS = 32
+
+# serving histograms: rendered from the decoders' telemetry snapshots
+# (serving/stats.py feeds Histogram.snapshot() dicts under snap["hist"])
+SERVING_HISTOGRAMS = {
+    "kubeml_serving_first_token_seconds": (
+        "first_token", "Time-to-first-token distribution"),
+    "kubeml_serving_request_seconds": (
+        "request", "Full request latency distribution"),
+    "kubeml_serving_decode_step_seconds": (
+        "decode_step", "Per-decode-step device time (chunk fetch / steps)"),
+}
+
 SERVING_GAUGES = {
     "kubeml_serving_tokens_per_second": (
         "tokens_per_second", "Sustained decode rate (10s window)"),
@@ -63,10 +161,18 @@ SERVING_GAUGES = {
         "latency_p50_seconds", "Median request latency (recent window)"),
     "kubeml_serving_latency_p95_seconds": (
         "latency_p95_seconds", "p95 request latency (recent window)"),
+    "kubeml_serving_latency_p99_seconds": (
+        "latency_p99_seconds", "p99 request latency (recent window)"),
+    "kubeml_serving_latency_max_seconds": (
+        "latency_max_seconds", "Max request latency (recent window)"),
     "kubeml_serving_first_token_p50_seconds": (
         "first_token_p50_seconds", "Median time to first token"),
     "kubeml_serving_first_token_p95_seconds": (
         "first_token_p95_seconds", "p95 time to first token"),
+    "kubeml_serving_first_token_p99_seconds": (
+        "first_token_p99_seconds", "p99 time to first token"),
+    "kubeml_serving_first_token_max_seconds": (
+        "first_token_max_seconds", "Max time to first token (recent window)"),
 }
 
 
@@ -75,6 +181,9 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         # {(metric, jobid): value}
         self._values: Dict[Tuple[str, str], float] = {}
+        # {(metric, jobid): Histogram}; insertion-ordered for oldest-job
+        # eviction past MAX_HISTOGRAM_JOBS
+        self._hists: Dict[Tuple[str, str], Histogram] = {}
         self._running: Dict[str, int] = {"train": 0, "inference": 0}
         # () -> {model_id: telemetry dict} from the PS's resident decoders
         # (serving/batcher.telemetry); set by the PS, read at render time
@@ -94,6 +203,34 @@ class MetricsRegistry:
             self._values[("kubeml_job_epoch_duration_seconds", jid)] = u.epoch_duration
             if u.moe_overflow >= 0.0:
                 self._values[("kubeml_job_moe_overflow", jid)] = u.moe_overflow
+            # promote the flattened timings into real distributions
+            self._observe("kubeml_job_epoch_seconds", jid, (u.epoch_duration,))
+            self._observe("kubeml_job_round_seconds", jid,
+                          u.round_seconds or ())
+            if u.merge_seconds >= 0.0:
+                self._observe("kubeml_job_merge_seconds", jid,
+                              (u.merge_seconds,))
+
+    def _observe(self, metric: str, job_id: str, values) -> None:
+        """Observe into a per-(metric, jobid) histogram; caller holds _lock.
+        Bounded: past MAX_HISTOGRAM_JOBS distinct jobs per metric the oldest
+        job's series evicts (finished jobs' series deliberately linger —
+        histograms are cumulative and the distribution IS the artifact)."""
+        if not values:
+            return
+        h = self._hists.get((metric, job_id))
+        if h is None:
+            h = self._hists[(metric, job_id)] = Histogram()
+            jobs = [j for m, j in self._hists if m == metric]
+            while len(jobs) > MAX_HISTOGRAM_JOBS:
+                self._hists.pop((metric, jobs.pop(0)), None)
+        for v in values:
+            h.observe(v)
+
+    def observe(self, metric: str, job_id: str, value: float) -> None:
+        """Public single-value observe (engine hooks outside MetricUpdate)."""
+        with self._lock:
+            self._observe(metric, job_id, (value,))
 
     def clear(self, job_id: str) -> None:
         """Drop a finished job's series (reference: metrics.go:100-106)."""
@@ -119,6 +256,12 @@ class MetricsRegistry:
                 lines.append(f"# TYPE {metric} gauge")
                 for jid, v in sorted(series):
                     lines.append(f'{metric}{{jobid="{jid}"}} {v}')
+            for metric, help_text in HISTOGRAMS.items():
+                lines.append(f"# HELP {metric} {help_text}")
+                lines.append(f"# TYPE {metric} histogram")
+                for (m, jid), h in sorted(self._hists.items()):
+                    if m == metric:
+                        lines.extend(h.render(metric, "jobid", jid))
             lines.append(f"# HELP {RUNNING} Number of running tasks")
             lines.append(f"# TYPE {RUNNING} gauge")
             for kind, n in sorted(self._running.items()):
@@ -146,6 +289,14 @@ class MetricsRegistry:
             for model, snap in sorted(per_model.items()):
                 if key in snap:
                     lines.append(f'{metric}{{model="{model}"}} {snap[key]}')
+        for metric, (key, help_text) in SERVING_HISTOGRAMS.items():
+            lines.append(f"# HELP {metric} {help_text}")
+            lines.append(f"# TYPE {metric} histogram")
+            for model, snap in sorted(per_model.items()):
+                hist_snap = (snap.get("hist") or {}).get(key)
+                if hist_snap:
+                    lines.extend(Histogram.render_snapshot(
+                        metric, hist_snap, "model", model))
         return "\n".join(lines) + "\n"
 
     def get(self, metric: str, job_id: str) -> float:

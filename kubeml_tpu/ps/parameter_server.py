@@ -34,8 +34,10 @@ from ..functions.registry import FunctionRegistry
 from ..storage.checkpoint import FINAL_TAG, CheckpointStore
 from ..storage.history import HistoryStore
 from ..storage.store import ShardStore
+from ..utils import tracing
 from ..utils.errorhook import report_error
 from .metrics import MetricsRegistry
+from .traces import TraceStore
 
 log = logging.getLogger("kubeml.ps")
 
@@ -94,6 +96,9 @@ class ParameterServer:
         # serving telemetry: /metrics renders each resident decoder's
         # counters/latency quantiles next to the training gauges
         self.metrics.set_serving_source(self._serving_telemetry)
+        # span collector: job runners/workers POST finished spans here, the
+        # controller's /tasks/{id}/trace merges them with local spans
+        self.traces = TraceStore()
         self.devices = devices
         self.scheduler = None  # bound after construction (circular dep)
         self._jobs: Dict[str, _JobRecord] = {}
@@ -168,8 +173,14 @@ class ParameterServer:
             self._fail_start(task, e)
             raise
         runner = self._run_job if dist is None else self._run_job_dist
+        # the job thread is a new root otherwise: hand it the submitting
+        # request's trace context (bound by the scheduler loop / HTTP server
+        # on THIS thread, or carried on the task) so job.* spans stitch
+        ctx = tracing.current_context() or tracing.parse_traceparent(
+            task.trace_parent)
         thread = threading.Thread(
-            target=runner, args=(task, job, placeholder),
+            target=self._run_job_traced,
+            args=(runner, ctx, task, job, placeholder),
             name=f"job-{task.job_id}", daemon=True
         )
         placeholder.job = job
@@ -239,7 +250,7 @@ class ParameterServer:
         import subprocess
         import sys
 
-        import requests
+        from ..utils import traced_http as requests
 
         placeholder = self._reserve_slot(task)
         try:
@@ -566,6 +577,16 @@ class ParameterServer:
             with self._dist_lock:
                 self.dist.broadcast_obj({"cmd": "shutdown"})
 
+    def _run_job_traced(self, runner, ctx, task: TrainTask, job: TrainJob,
+                        record) -> None:
+        """Job-thread entry: bind the submitter's trace context + the task id
+        (log/webhook correlation) and record one PS-side umbrella span for
+        the job's whole run, then delegate to the real runner."""
+        with tracing.use_context(ctx), tracing.bind_task(task.job_id):
+            with tracing.get_tracer().span("ps.job.run", service="ps",
+                                           job=task.job_id):
+                runner(task, job, record)
+
     def _run_job(self, task: TrainTask, job: TrainJob, record=None) -> None:
         try:
             job.train()
@@ -662,7 +683,7 @@ class ParameterServer:
         if record is None:
             raise JobNotFoundError(job_id)
         if record.url is not None:
-            import requests
+            from ..utils import traced_http as requests
 
             try:
                 requests.post(f"{record.url}/update",
@@ -676,6 +697,33 @@ class ParameterServer:
             return
         box.parallelism = parallelism
         box.event.set()
+
+    # --- traces (span collection; no reference counterpart) ---
+
+    def post_trace(self, task_id: str, spans: List[dict]) -> None:
+        """``POST /traces/{taskId}``: a worker/job-runner process delivers its
+        finished spans for a task (utils.tracing.post_task_spans)."""
+        self.traces.add(task_id, spans)
+
+    def get_trace(self, task_id: str) -> dict:
+        """The merged span set of a task: spans POSTed by remote processes
+        plus this process's own (controller/scheduler/PS server spans and
+        threaded-job spans share the global tracer). Deduped by span_id —
+        in the all-in-one cluster the same tracer backs every service, and a
+        runner that raced a retry may have delivered twice."""
+        merged: List[dict] = []
+        seen = set()
+        for d in self.traces.get(task_id) + tracing.get_tracer().task_dicts(task_id):
+            sid = d.get("span_id")
+            if sid and sid in seen:
+                continue
+            if sid:
+                seen.add(sid)
+            merged.append(d)
+        merged.sort(key=lambda d: d.get("start", 0.0))
+        trace_ids = sorted({d["trace_id"] for d in merged if d.get("trace_id")})
+        return {"task_id": task_id, "trace_ids": trace_ids,
+                "dropped": self.traces.dropped(task_id), "spans": merged}
 
     # --- queries / control ---
 
@@ -698,7 +746,7 @@ class ParameterServer:
         if record is None:
             raise JobNotFoundError(job_id)
         if record.url is not None:
-            import requests
+            from ..utils import traced_http as requests
 
             try:
                 r = requests.delete(f"{record.url}/stop", timeout=10)
@@ -760,7 +808,7 @@ class ParameterServer:
             except Exception:
                 log.debug("tensor-socket infer for %s failed; HTTP fallback",
                           model_id, exc_info=True)
-            import requests
+            from ..utils import traced_http as requests
 
             from ..api.errors import error_from_envelope
 
@@ -793,7 +841,7 @@ class ParameterServer:
         with self._lock:
             record = self._jobs.get(model_id)
         if record is not None and record.url is not None:
-            import requests
+            from ..utils import traced_http as requests
 
             from ..api.errors import error_from_envelope
 

@@ -1,0 +1,64 @@
+"""Span collection store — the PS side of distributed tracing.
+
+Workers and standalone job runners POST their finished spans to the PS
+(``/traces/{task_id}``, ps.transport) when a job ends; the controller's
+``GET /tasks/{id}/trace`` merges them with the control plane's own spans
+into one tree (``kubeml trace <task-id>`` renders it as a single
+Chrome/Perfetto file). Bounded both ways — per task and across tasks — so a
+long-lived PS never grows without limit; eviction is oldest-task-first.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List
+
+# bounds: traces are a debugging artifact, not a database
+MAX_TASKS = 64
+MAX_SPANS_PER_TASK = 50_000
+
+
+class TraceStore:
+    """Thread-safe {task_id: [span dicts]} with task-count and span caps."""
+
+    def __init__(self, max_tasks: int = MAX_TASKS,
+                 max_spans_per_task: int = MAX_SPANS_PER_TASK):
+        self.max_tasks = max_tasks
+        self.max_spans_per_task = max_spans_per_task
+        self._tasks: "OrderedDict[str, List[dict]]" = OrderedDict()
+        self._dropped: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def add(self, task_id: str, spans: List[dict]) -> int:
+        """Append spans for a task; returns how many were kept."""
+        kept = 0
+        with self._lock:
+            bucket = self._tasks.get(task_id)
+            if bucket is None:
+                bucket = self._tasks[task_id] = []
+                while len(self._tasks) > self.max_tasks:
+                    evicted, _ = self._tasks.popitem(last=False)
+                    self._dropped.pop(evicted, None)
+            for s in spans:
+                if not isinstance(s, dict):
+                    continue
+                if len(bucket) < self.max_spans_per_task:
+                    bucket.append(s)
+                    kept += 1
+                else:
+                    self._dropped[task_id] = self._dropped.get(task_id, 0) + 1
+        return kept
+
+    def get(self, task_id: str) -> List[dict]:
+        with self._lock:
+            return list(self._tasks.get(task_id, ()))
+
+    def dropped(self, task_id: str) -> int:
+        with self._lock:
+            return self._dropped.get(task_id, 0)
+
+    def clear(self, task_id: str) -> None:
+        with self._lock:
+            self._tasks.pop(task_id, None)
+            self._dropped.pop(task_id, None)

@@ -10,11 +10,10 @@ from __future__ import annotations
 
 from typing import Optional
 
-import requests
-
 from ..api.config import Config, get_config
 from ..api.errors import error_from_envelope
 from ..api.types import TrainTask
+from ..utils import traced_http as requests  # traceparent-stamped requests
 from ..utils.httpd import Request, Response, Router, Service
 from .parameter_server import ParameterServer
 
@@ -34,6 +33,10 @@ class PSAPI:
         # /finish/{jobId}, ps/api.go:335-345)
         router.route("POST", "/metrics/{jobId}", self._metrics_update)
         router.route("POST", "/finish/{jobId}", self._finish)
+        # span collection: workers/job runners POST finished spans here; the
+        # controller's /tasks/{id}/trace reads the merged set back
+        router.route("POST", "/traces/{taskId}", self._traces_post)
+        router.route("GET", "/traces/{taskId}", self._traces_get)
         self.service = Service(router, self.cfg.host, self.cfg.ps_port)
 
     def _start(self, req: Request):
@@ -75,6 +78,19 @@ class PSAPI:
             req.params["jobId"], status=body.get("status", ""), error=body.get("error")
         )
         return {}
+
+    def _traces_post(self, req: Request):
+        body = req.json() or {}
+        spans = body.get("spans")
+        if not isinstance(spans, list):
+            from ..api.errors import KubeMLError
+
+            raise KubeMLError("trace payload must be {spans: [...]}", 400)
+        self.ps.post_trace(req.params["taskId"], spans)
+        return {"accepted": len(spans)}
+
+    def _traces_get(self, req: Request):
+        return self.ps.get_trace(req.params["taskId"])
 
     def start(self) -> "PSAPI":
         self.service.start()
@@ -130,6 +146,14 @@ class PSClient:
 
     def metrics_text(self) -> str:
         return requests.get(f"{self.url}/metrics", timeout=self.timeout).text
+
+    def post_trace(self, task_id: str, spans: list) -> None:
+        _check(requests.post(f"{self.url}/traces/{task_id}",
+                             json={"spans": spans}, timeout=self.timeout))
+
+    def get_trace(self, task_id: str) -> dict:
+        return _check(requests.get(f"{self.url}/traces/{task_id}",
+                                   timeout=self.timeout))
 
     def health(self) -> bool:
         try:

@@ -20,6 +20,7 @@ from typing import Optional
 from ..api.config import Config, get_config
 from ..api.errors import KubeMLError
 from ..api.types import JobState, TrainRequest, TrainTask
+from ..utils import tracing
 from .policy import SchedulerPolicy, ThroughputBasedPolicy
 from .queue import TaskQueue
 
@@ -86,7 +87,11 @@ class Scheduler:
                 raise KubeMLError(f"job {request.job_id!r} is still active", 409)
             job_id = request.job_id or create_job_id()
             self._active_ids.add(job_id)
-        task = TrainTask(job_id=job_id, parameters=request, state=JobState())
+        # the queue hop loses the thread — the submitting request's trace
+        # context (the controller/scheduler server span) rides the task
+        ctx = tracing.current_context()
+        task = TrainTask(job_id=job_id, parameters=request, state=JobState(),
+                         trace_parent=ctx.traceparent() if ctx else "")
         self.queue.push(task)
         log.info("queued train task %s (%s on %s)", job_id, request.function_name, request.dataset)
         return job_id
@@ -137,6 +142,16 @@ class Scheduler:
                 log.exception("scheduling task %s failed", task.job_id)
 
     def _schedule(self, task: TrainTask) -> None:
+        # re-bind the submitter's trace context (it crossed the queue on the
+        # task) so the scheduling span and every downstream hop — PS /start,
+        # runner /update — stitch under the original request
+        with tracing.use_context(tracing.parse_traceparent(task.trace_parent)):
+            with tracing.get_tracer().span("scheduler.schedule",
+                                           service="scheduler",
+                                           job=task.job_id):
+                self._schedule_inner(task)
+
+    def _schedule_inner(self, task: TrainTask) -> None:
         decision = self.policy.calculate_parallelism(task)
         if decision is None:
             log.debug("dropping stale update for finished job %s", task.job_id)

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-import requests
+from ..utils import traced_http as requests  # traceparent-stamped requests
 
 from ..api.config import Config, get_config
 from ..api.errors import error_from_envelope

@@ -1041,7 +1041,11 @@ class BatchingDecoder:
                     self._complete_row(slot, row)
             return
         _, packed, snapshot = rec
+        t_fetch = time.monotonic()
         packed = np.asarray(packed)  # [T, S]; -1 = not emitted
+        # decode-step histogram feed: the blocking fetch waits on the chunk's
+        # device execution, so wall/steps is the per-step decode latency
+        self.stats.chunk_fetched(time.monotonic() - t_fetch, packed.shape[0])
         self._warmed = True
         for slot, row in enumerate(snapshot):
             if row is None or row.done:

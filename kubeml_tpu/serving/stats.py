@@ -12,7 +12,10 @@ gauges (VERDICT r4 weak-4).
 Latency quantiles come from a bounded ring of recent requests (no
 unbounded growth on a long-lived server); sustained tokens/sec is a sliding
 ~10 s window over emission timestamps so the gauge reads as "current rate",
-not lifetime average.
+not lifetime average. Alongside the windowed quantiles (p50/p95/p99/max),
+cumulative Prometheus histograms (ps/metrics.Histogram) record TTFT, full
+request latency, and per-decode-step device time since process start —
+``_bucket`` series the registry renders next to the training histograms.
 """
 
 from __future__ import annotations
@@ -21,6 +24,8 @@ import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
+
+from ..ps.metrics import Histogram
 
 # ring sizes: enough for stable p95 under load, bounded for a resident server
 LATENCY_RING = 512
@@ -45,6 +50,11 @@ class DecoderStats:
         self._lat: deque = deque(maxlen=LATENCY_RING)        # (total_s,)
         self._first: deque = deque(maxlen=LATENCY_RING)      # first-token s
         self._emits: deque = deque()  # (t, n_tokens) for the rate window
+        # cumulative bucket histograms (process lifetime, not windowed):
+        # rendered as kubeml_serving_*_seconds_bucket on the PS /metrics
+        self._hist_first = Histogram()
+        self._hist_request = Histogram()
+        self._hist_decode_step = Histogram()
         # live gauges are read from the decoder at render time (queue depth,
         # busy slots) — they belong to the engine's own state, not counters
 
@@ -62,6 +72,15 @@ class DecoderStats:
         with self._lock:
             self.chunks += 1
 
+    def chunk_fetched(self, seconds: float, steps: int) -> None:
+        """A decode chunk's results landed on the host: ``seconds`` is the
+        blocking fetch wall time, ``steps`` the decode steps it covered —
+        the per-step quotient is the decode-step latency distribution."""
+        if steps <= 0:
+            return
+        with self._lock:
+            self._hist_decode_step.observe(float(seconds) / steps)
+
     def emitted(self, n: int) -> None:
         now = time.monotonic()
         with self._lock:
@@ -74,11 +93,13 @@ class DecoderStats:
     def first_token(self, seconds: float) -> None:
         with self._lock:
             self._first.append(float(seconds))
+            self._hist_first.observe(float(seconds))
 
     def completed(self, latency_s: float) -> None:
         with self._lock:
             self.requests_completed += 1
             self._lat.append(float(latency_s))
+            self._hist_request.observe(float(latency_s))
 
     def rejected(self) -> None:
         with self._lock:
@@ -118,7 +139,8 @@ class DecoderStats:
         return vs[idx]
 
     def snapshot(self) -> Dict[str, float]:
-        """One consistent read of everything the exposition needs."""
+        """One consistent read of everything the exposition needs (plus the
+        cumulative histograms as plain dicts under ``"hist"``)."""
         with self._lock:
             lat = list(self._lat)
             first = list(self._first)
@@ -133,8 +155,17 @@ class DecoderStats:
                 "admission_waves": float(self.admission_waves),
                 "chunks": float(self.chunks),
             }
+            hist = {}
+            for key, h in (("first_token", self._hist_first),
+                           ("request", self._hist_request),
+                           ("decode_step", self._hist_decode_step)):
+                if h.count:
+                    hist[key] = h.snapshot()
+        if hist:
+            out["hist"] = hist
         out["tokens_per_second"] = self.tokens_per_second()
-        for q, name in ((0.5, "p50"), (0.95, "p95")):
+        for q, name in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99"),
+                        (1.0, "max")):
             v = self._quantile(lat, q)
             if v is not None:
                 out[f"latency_{name}_seconds"] = v

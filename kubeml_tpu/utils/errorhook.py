@@ -31,6 +31,17 @@ def report_error(context: str, message: str, wait: bool = False,
         return
     payload = {"source": "kubeml-tpu", "context": context,
                "error": str(message), **fields}
+    # trace correlation: stamp the reporting thread's bound trace/task ids
+    # (utils.tracing) so a crash report links to the request's span tree and
+    # the job's log lines; explicit caller fields win
+    from .tracing import current_context, current_task
+
+    ctx = current_context()
+    if ctx is not None:
+        payload.setdefault("trace_id", ctx.trace_id)
+    task = current_task()
+    if task is not None:
+        payload.setdefault("task_id", task)
 
     def post():
         try:

@@ -157,13 +157,34 @@ class Service:
                 self.wfile.write(b"0\r\n\r\n")
 
             def _handle(self, method: str):
+                from . import tracing
+
                 try:
                     parsed = urlparse(self.path)
                     length = int(self.headers.get("Content-Length") or 0)
                     body = self.rfile.read(length) if length else b""
-                    resp = router.dispatch(
-                        method, parsed.path, parse_qs(parsed.query), body, self.headers
-                    )
+                    # distributed tracing: bind the inbound W3C context to
+                    # this handler thread (downstream hops forward it even
+                    # when local recording is off) and record a server span
+                    # per request. /health and /metrics are excluded —
+                    # liveness polls and Prometheus scrapes would otherwise
+                    # dominate (and slowly evict) every trace buffer.
+                    ctx = tracing.parse_traceparent(
+                        self.headers.get("traceparent"))
+                    tracer = tracing.get_tracer()
+                    with tracing.use_context(ctx):
+                        if parsed.path in ("/health", "/metrics"):
+                            resp = router.dispatch(
+                                method, parsed.path, parse_qs(parsed.query),
+                                body, self.headers)
+                        else:
+                            with tracer.span(
+                                    f"{router.name} {method} {parsed.path}",
+                                    service=router.name, method=method,
+                                    path=parsed.path):
+                                resp = router.dispatch(
+                                    method, parsed.path, parse_qs(parsed.query),
+                                    body, self.headers)
                 except KubeMLError as e:
                     resp = Response(e.to_dict(), status=e.status_code)
                 except BrokenPipeError:

@@ -1,0 +1,43 @@
+"""Outbound HTTP with W3C trace propagation.
+
+Drop-in for the ``requests`` surface the control-plane clients use
+(``get/post/put/delete`` plus the exception/response types re-exported), with
+one addition: every request is stamped with the calling thread's current
+trace context as a ``traceparent`` header (utils.tracing.trace_headers), so
+every internal hop — controller → scheduler → PS → job runner → storage —
+carries the trace across the process boundary. Caller-supplied headers win
+on conflict.
+"""
+
+from __future__ import annotations
+
+import requests
+
+from .tracing import trace_headers
+
+# re-exported so call sites can treat this module as their `requests`
+RequestException = requests.RequestException
+ConnectionError = requests.ConnectionError
+Timeout = requests.Timeout
+Response = requests.Response
+
+
+def request(method: str, url: str, **kwargs) -> requests.Response:
+    kwargs["headers"] = trace_headers(kwargs.get("headers"))
+    return requests.request(method, url, **kwargs)
+
+
+def get(url: str, **kwargs) -> requests.Response:
+    return request("GET", url, **kwargs)
+
+
+def post(url: str, **kwargs) -> requests.Response:
+    return request("POST", url, **kwargs)
+
+
+def put(url: str, **kwargs) -> requests.Response:
+    return request("PUT", url, **kwargs)
+
+
+def delete(url: str, **kwargs) -> requests.Response:
+    return request("DELETE", url, **kwargs)

@@ -1,4 +1,4 @@
-"""Span tracing + device profiling.
+"""Distributed span tracing + device profiling.
 
 The reference has no tracing or profiling at all — only zap log lines with
 ad-hoc timings (SURVEY §5: merge time ml/pkg/train/job.go:397-412, epoch
@@ -8,6 +8,14 @@ ElapsedTime job.go:321-322). This subsystem is the TPU-native upgrade:
   when disabled; spans nest via a context manager and carry attributes
   (job id, epoch, round, parallelism...). Export as Chrome trace-event JSON
   (load in chrome://tracing / Perfetto) or per-name summary statistics.
+* **Trace identity** (Dapper-style): every span carries ``trace_id`` /
+  ``span_id`` / ``parent_id``. The identity crosses process boundaries as a
+  W3C ``traceparent`` header (:func:`parse_traceparent` /
+  :meth:`TraceContext.traceparent`): the HTTP server (utils.httpd) binds the
+  inbound context to the handler thread, outbound hops
+  (utils.traced_http) stamp the current context onto the request — so a
+  train request's spans stitch into one tree across CLI → controller →
+  scheduler → PS → job runner.
 * :func:`device_profile` — wraps ``jax.profiler.trace`` so a job (or bench run)
   can capture a TensorBoard/XProf device trace of the XLA execution itself.
 
@@ -22,8 +30,11 @@ import atexit
 import json
 import logging
 import os
+import re
 import threading
 import time
+import uuid
+from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -31,7 +42,150 @@ from typing import Any, Dict, Iterator, List, Optional
 
 log = logging.getLogger("kubeml.trace")
 
-MAX_SPANS = 200_000  # hard cap: a runaway loop must not eat the host's RAM
+# hard cap: a runaway loop must not eat the host's RAM. The cap is a RING —
+# past it the OLDEST span evicts — so a long-lived traced service (weeks of
+# server spans) still records every NEW task's trace instead of silently
+# going dark once the buffer fills.
+MAX_SPANS = 200_000
+
+
+# --- trace identity (W3C trace-context) ---
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """The propagated part of a span: who the next span's parent is."""
+
+    trace_id: str  # 32 lowercase hex chars
+    span_id: str  # 16 lowercase hex chars
+
+    def traceparent(self) -> str:
+        """W3C ``traceparent`` header value (version 00, sampled)."""
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[TraceContext]:
+    """Decode a W3C ``traceparent`` header; None on absent/malformed input
+    (a bad peer header must never fail the request it rode in on)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None:
+        return None
+    version, trace_id, span_id = m.group(1), m.group(2), m.group(3)
+    # per spec: version ff is invalid, all-zero ids are invalid
+    if version == "ff" or trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return TraceContext(trace_id=trace_id, span_id=span_id)
+
+
+# Thread-local context stack. Deliberately independent of Tracer.enabled: a
+# process with tracing off must still FORWARD the inbound context unchanged
+# (e.g. a controller with KUBEML_TRACE unset between a traced CLI and a
+# traced worker), so binding always works and only span *recording* is gated.
+_tls = threading.local()
+
+
+def _ctx_stack() -> list:
+    s = getattr(_tls, "ctx", None)
+    if s is None:
+        s = _tls.ctx = []
+    return s
+
+
+def current_context() -> Optional[TraceContext]:
+    """The trace context of this thread (innermost active span, or the
+    inbound context bound by the HTTP server / a job thread)."""
+    s = _ctx_stack()
+    return s[-1] if s else None
+
+
+@contextmanager
+def use_context(ctx: Optional[TraceContext]) -> Iterator[None]:
+    """Bind an externally-received trace context to this thread for the
+    duration of the block (no span is recorded). None is a no-op."""
+    if ctx is None:
+        yield
+        return
+    s = _ctx_stack()
+    s.append(ctx)
+    try:
+        yield
+    finally:
+        s.pop()
+
+
+def trace_headers(extra: Optional[dict] = None) -> dict:
+    """HTTP headers for an outbound hop: caller's headers plus the current
+    ``traceparent`` (when a context is bound). Shared by utils.traced_http."""
+    headers = dict(extra or {})
+    ctx = current_context()
+    if ctx is not None:
+        headers.setdefault("traceparent", ctx.traceparent())
+    return headers
+
+
+# --- task binding (log/webhook correlation, satellite of the trace tree) ---
+
+
+def _task_stack() -> list:
+    s = getattr(_tls, "task", None)
+    if s is None:
+        s = _tls.task = []
+    return s
+
+
+def current_task() -> Optional[str]:
+    s = _task_stack()
+    return s[-1] if s else None
+
+
+@contextmanager
+def bind_task(task_id: Optional[str]) -> Iterator[None]:
+    """Associate a task/job id with this thread (job threads bind it so log
+    records and error-webhook payloads correlate with traces)."""
+    if not task_id:
+        yield
+        return
+    s = _task_stack()
+    s.append(task_id)
+    try:
+        yield
+    finally:
+        s.pop()
+
+
+class TraceLogFilter(logging.Filter):
+    """Injects ``trace_id`` and ``task_id`` into every log record (from the
+    thread's bound trace context / task), so a format string can carry
+    ``%(trace_id)s``/``%(task_id)s`` and log lines correlate with traces."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        ctx = current_context()
+        record.trace_id = ctx.trace_id if ctx is not None else "-"
+        record.task_id = current_task() or "-"
+        return True
+
+
+def add_log_context(logger: Optional[logging.Logger] = None) -> None:
+    """Attach :class:`TraceLogFilter` to every handler of ``logger`` (root by
+    default). Idempotent — safe to call at each service boot."""
+    logger = logger or logging.getLogger()
+    for handler in logger.handlers:
+        if not any(isinstance(f, TraceLogFilter) for f in handler.filters):
+            handler.addFilter(TraceLogFilter())
 
 
 @dataclass
@@ -41,15 +195,40 @@ class Span:
     duration: float  # seconds
     thread: int
     attrs: Dict[str, Any] = field(default_factory=dict)
+    # trace identity: spans across processes sharing a trace_id stitch into
+    # one tree via parent_id links
+    trace_id: str = ""
+    span_id: str = ""
+    parent_id: Optional[str] = None
+    # logical process ("controller", "ps", "worker", ...): the merged
+    # Chrome trace renders one process row per service
+    service: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "start": self.start,
+            "duration": self.duration,
+            "thread": self.thread,
+            "attrs": {k: _json_safe(v) for k, v in self.attrs.items()},
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "service": self.service,
+            "pid": os.getpid(),
+        }
 
 
 class Tracer:
     """Span recorder. Disabled by default: ``span()`` costs one attribute read."""
 
-    def __init__(self, enabled: bool = False, out_dir: Optional[Path] = None):
+    def __init__(self, enabled: bool = False, out_dir: Optional[Path] = None,
+                 service: Optional[str] = None):
         self.enabled = enabled
         self.out_dir = Path(out_dir) if out_dir else None
-        self._spans: List[Span] = []
+        # default logical-process label for spans that don't name one
+        self.service = service or f"proc-{os.getpid()}"
+        self._spans: "deque[Span]" = deque()
         self._lock = threading.Lock()
         self._dropped = 0
 
@@ -69,35 +248,61 @@ class Tracer:
             self._spans.clear()
             self._dropped = 0
 
+    @property
+    def dropped(self) -> int:
+        """Oldest spans evicted past the MAX_SPANS cap since the last clear()."""
+        with self._lock:
+            return self._dropped
+
     # --- recording ---
 
     def _append(self, s: Span) -> None:
         with self._lock:
-            if len(self._spans) < MAX_SPANS:
-                self._spans.append(s)
-            else:
+            self._spans.append(s)
+            while len(self._spans) > MAX_SPANS:
+                self._spans.popleft()
                 self._dropped += 1
+
+    def _identify(self, attrs: Dict[str, Any]) -> Span:
+        """A new Span skeleton carrying trace identity: child of the thread's
+        current context, or a fresh trace root."""
+        service = attrs.pop("service", None) or self.service
+        parent = current_context()
+        return Span(
+            name="", start=0.0, duration=0.0, thread=threading.get_ident(),
+            attrs=attrs,
+            trace_id=parent.trace_id if parent is not None else new_trace_id(),
+            span_id=new_span_id(),
+            parent_id=parent.span_id if parent is not None else None,
+            service=service,
+        )
 
     @contextmanager
     def span(self, name: str, **attrs: Any) -> Iterator[Optional[Span]]:
         if not self.enabled:
             yield None
             return
-        t0 = time.time()
-        s = Span(name=name, start=t0, duration=0.0,
-                 thread=threading.get_ident(), attrs=attrs)
+        s = self._identify(attrs)
+        s.name = name
+        s.start = time.time()
+        stack = _ctx_stack()
+        stack.append(TraceContext(s.trace_id, s.span_id))
         try:
             yield s
         finally:
-            s.duration = time.time() - t0
+            stack.pop()
+            s.duration = time.time() - s.start
             self._append(s)
 
     def record(self, name: str, duration: float, **attrs: Any) -> None:
         """Record an externally-timed span (e.g. a device-side duration)."""
         if not self.enabled:
             return
-        self._append(Span(name=name, start=time.time() - duration, duration=duration,
-                          thread=threading.get_ident(), attrs=attrs))
+        s = self._identify(attrs)
+        s.name = name
+        s.start = time.time() - duration
+        s.duration = duration
+        self._append(s)
 
     # --- reading ---
 
@@ -107,6 +312,20 @@ class Tracer:
         if name is not None:
             out = [s for s in out if s.name == name]
         return out
+
+    def task_spans(self, task_id: str) -> List[Span]:
+        """Every span belonging to a task: spans tagged ``job=task_id`` plus
+        every other span sharing one of those spans' trace ids (the HTTP hop
+        spans of the same request flow)."""
+        spans = self.spans()
+        trace_ids = {s.trace_id for s in spans
+                     if s.trace_id and s.attrs.get("job") == task_id}
+        return [s for s in spans
+                if s.attrs.get("job") == task_id
+                or (s.trace_id and s.trace_id in trace_ids)]
+
+    def task_dicts(self, task_id: str) -> List[Dict[str, Any]]:
+        return [s.to_dict() for s in self.task_spans(task_id)]
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         """Per-name {count, total_s, mean_s, max_s}."""
@@ -153,8 +372,62 @@ class Tracer:
         path.parent.mkdir(parents=True, exist_ok=True)
         path.write_text(json.dumps({"traceEvents": events}))
         if self._dropped:
-            log.warning("trace dropped %d spans past the %d cap", self._dropped, MAX_SPANS)
+            log.warning("trace evicted %d oldest spans past the %d cap",
+                        self._dropped, MAX_SPANS)
         return path
+
+
+def merge_chrome_trace(span_dicts: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """One Chrome/Perfetto trace spanning processes: span dicts (Span.to_dict,
+    possibly collected over HTTP from several processes) grouped into one
+    process row per ``service`` label, trace identity preserved in args."""
+    procs: Dict[str, int] = {}
+    events: List[Dict[str, Any]] = []
+    for d in span_dicts:
+        key = d.get("service") or f"pid-{d.get('pid', 0)}"
+        if key not in procs:
+            procs[key] = len(procs) + 1
+            events.append({"ph": "M", "name": "process_name", "pid": procs[key],
+                           "args": {"name": key}})
+    for d in span_dicts:
+        key = d.get("service") or f"pid-{d.get('pid', 0)}"
+        args = dict(d.get("attrs") or {})
+        for k in ("trace_id", "span_id", "parent_id"):
+            if d.get(k):
+                args[k] = d[k]
+        events.append({
+            "name": d.get("name", ""),
+            "ph": "X",
+            "ts": float(d.get("start", 0.0)) * 1e6,
+            "dur": float(d.get("duration", 0.0)) * 1e6,
+            "pid": procs[key],
+            "tid": int(d.get("thread", 0)) % (1 << 31),
+            "args": args,
+        })
+    return {"traceEvents": events}
+
+
+def post_task_spans(ps_url: str, task_id: str,
+                    tracer: Optional["Tracer"] = None) -> bool:
+    """POST this process's finished spans for a task to the PS span collector
+    (``/traces/{task_id}``). Fire-at-exit path for job runners / workers;
+    never raises. Returns whether anything was delivered."""
+    tracer = tracer or get_tracer()
+    if not tracer.enabled:
+        return False
+    spans = tracer.task_dicts(task_id)
+    if not spans:
+        return False
+    try:
+        from . import traced_http
+
+        traced_http.post(f"{ps_url}/traces/{task_id}",
+                         json={"spans": spans}, timeout=10)
+        return True
+    except Exception:
+        log.debug("posting %d spans for %s failed", len(spans), task_id,
+                  exc_info=True)
+        return False
 
 
 def _json_safe(v: Any) -> Any:

@@ -181,16 +181,41 @@ class TestMetrics:
         m = MetricsRegistry()
         m.task_started("train")
         m.update(MetricUpdate(job_id="abc", train_loss=1.5, accuracy=42.0,
-                              validation_loss=2.0, parallelism=4, epoch_duration=3.0))
+                              validation_loss=2.0, parallelism=4, epoch_duration=3.0,
+                              round_seconds=[0.2, 0.4], merge_seconds=0.05))
         text = m.render()
         assert 'kubeml_job_train_loss{jobid="abc"} 1.5' in text
         assert 'kubeml_job_parallelism{jobid="abc"} 4.0' in text
         assert 'kubeml_job_running_total{type="train"} 1' in text
+        # the flattened timings became real distributions
+        assert "# TYPE kubeml_job_epoch_seconds histogram" in text
+        assert 'kubeml_job_epoch_seconds_bucket{jobid="abc",le="5"} 1' in text
+        assert 'kubeml_job_round_seconds_count{jobid="abc"} 2' in text
+        assert 'kubeml_job_merge_seconds_bucket{jobid="abc",le="0.05"} 1' in text
         m.clear("abc")
         m.task_finished("train")
         text = m.render()
-        assert 'jobid="abc"' not in text
+        # gauges clear with the job (reference metrics.go:100-106) ...
+        assert 'kubeml_job_train_loss{jobid="abc"}' not in text
         assert 'kubeml_job_running_total{type="train"} 0' in text
+        # ... but histograms linger: they are cumulative and the finished
+        # job's latency distribution IS the artifact operators scrape
+        assert 'kubeml_job_epoch_seconds_count{jobid="abc"} 1' in text
+
+    def test_histogram_job_label_cap(self):
+        from kubeml_tpu.api.types import MetricUpdate
+        from kubeml_tpu.ps.metrics import MAX_HISTOGRAM_JOBS, MetricsRegistry
+
+        m = MetricsRegistry()
+        n = MAX_HISTOGRAM_JOBS + 8
+        for i in range(n):
+            m.update(MetricUpdate(job_id=f"job{i:03d}", epoch_duration=1.0))
+        text = m.render()
+        # oldest jobs evicted, newest retained, bounded total
+        assert 'kubeml_job_epoch_seconds_count{jobid="job000"}' not in text
+        assert f'kubeml_job_epoch_seconds_count{{jobid="job{n-1:03d}"}} 1' in text
+        kept = text.count("kubeml_job_epoch_seconds_count{")
+        assert kept == MAX_HISTOGRAM_JOBS
 
 
 @pytest.fixture

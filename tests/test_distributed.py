@@ -157,10 +157,20 @@ def test_dashboard_metrics_exist_in_registry():
     from kubeml_tpu.ps.metrics import MetricsRegistry
     from kubeml_tpu.api.types import MetricUpdate
 
+    from kubeml_tpu.serving.stats import DecoderStats
+
     reg = MetricsRegistry()
     reg.task_started("train")
     reg.update(MetricUpdate(job_id="j", train_loss=1.0, validation_loss=2.0,
-                            accuracy=50.0, parallelism=2, epoch_duration=1.5))
+                            accuracy=50.0, parallelism=2, epoch_duration=1.5,
+                            round_seconds=[0.2], merge_seconds=0.05))
+    # serving traffic so the histogram _bucket series render too (the
+    # dashboard's histogram_quantile panels query those directly)
+    stats = DecoderStats(slots=2)
+    stats.completed(0.2)
+    stats.first_token(0.05)
+    stats.chunk_fetched(0.1, 10)
+    reg.set_serving_source(lambda: {"m": stats.snapshot()})
     text = reg.render()
     d = json.loads((REPO / "deploy/grafana/kubeml-dashboard.json").read_text())
     import re

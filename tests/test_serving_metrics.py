@@ -45,9 +45,54 @@ def test_stats_counters_and_quantiles():
     assert snap["tokens_emitted"] == 8.0
     assert snap["latency_p50_seconds"] == 0.3
     assert snap["latency_p95_seconds"] == 1.0
+    assert snap["latency_p99_seconds"] == 1.0
+    assert snap["latency_max_seconds"] == 1.0
     assert snap["first_token_p50_seconds"] == 0.05
+    assert snap["first_token_max_seconds"] == 0.05
     # the rate window saw 8 tokens within the last 10s
     assert snap["tokens_per_second"] > 0.0
+
+
+def test_stats_p99_separates_from_max():
+    """p99 and max diverge on a wide-enough ring: one 10s outlier among 200
+    sub-second requests must move max but barely touch p99."""
+    s = DecoderStats(slots=8)
+    for _ in range(200):
+        s.completed(0.1)
+    s.completed(10.0)
+    snap = s.snapshot()
+    assert snap["latency_max_seconds"] == 10.0
+    assert snap["latency_p99_seconds"] == 0.1
+
+
+def test_stats_histograms_and_exposition():
+    """TTFT / request-latency / decode-step observations become cumulative
+    Prometheus histograms rendered with _bucket/_sum/_count series."""
+    s = DecoderStats(slots=4)
+    s.completed(0.3)
+    s.completed(4.0)
+    s.first_token(0.02)
+    s.chunk_fetched(0.08, 16)  # 5ms per decode step
+    s.chunk_fetched(0.0, 0)    # degenerate: ignored, not a ZeroDivisionError
+    snap = s.snapshot()
+    hist = snap["hist"]
+    assert hist["request"]["count"] == 2
+    assert hist["first_token"]["count"] == 1
+    assert hist["decode_step"]["count"] == 1
+    reg = MetricsRegistry()
+    reg.set_serving_source(lambda: {"m1": snap})
+    text = reg.render()
+    assert "# TYPE kubeml_serving_request_seconds histogram" in text
+    assert 'kubeml_serving_request_seconds_bucket{model="m1",le="0.5"} 1' in text
+    assert 'kubeml_serving_request_seconds_bucket{model="m1",le="+Inf"} 2' in text
+    assert 'kubeml_serving_request_seconds_count{model="m1"} 2' in text
+    assert 'kubeml_serving_first_token_seconds_bucket{model="m1",le="0.025"} 1' in text
+    assert 'kubeml_serving_decode_step_seconds_bucket{model="m1",le="0.005"} 1' in text
+    # no-traffic decoders render headers but no bucket series (valid prom)
+    reg.set_serving_source(lambda: {"m2": {"tokens_emitted": 0.0}})
+    text = reg.render()
+    assert "# TYPE kubeml_serving_decode_step_seconds histogram" in text
+    assert 'kubeml_serving_decode_step_seconds_bucket{model="m2"' not in text
 
 
 def test_decoder_telemetry_moves_under_traffic():
@@ -139,7 +184,11 @@ def test_serving_panels_in_dashboard():
     for needle in ("kubeml_serving_tokens_per_second",
                    "kubeml_serving_slot_occupancy",
                    "kubeml_serving_queue_depth",
-                   "kubeml_serving_latency_p95_seconds"):
+                   "kubeml_serving_latency_p95_seconds",
+                   "kubeml_serving_latency_p99_seconds",
+                   "kubeml_serving_first_token_seconds_bucket",
+                   "kubeml_serving_decode_step_seconds_bucket",
+                   "kubeml_job_epoch_seconds_bucket"):
         assert needle in exprs
 
 

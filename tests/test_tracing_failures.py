@@ -72,6 +72,130 @@ def test_tracer_thread_safety():
     assert len(t.spans()) == 1600
 
 
+def test_tracer_concurrent_nesting_stays_per_thread():
+    """The context stack is thread-local: concurrent threads nesting spans
+    must each see only their OWN parent links (a shared stack would cross-
+    wire parent ids under contention)."""
+    t = Tracer(enabled=True)
+
+    def worker(i):
+        for _ in range(50):
+            with t.span(f"outer{i}"):
+                with t.span(f"inner{i}"):
+                    pass
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    [x.start() for x in threads]
+    [x.join() for x in threads]
+    by_id = {s.span_id: s for s in t.spans()}
+    for s in t.spans():
+        if s.name.startswith("inner"):
+            i = s.name[len("inner"):]
+            parent = by_id[s.parent_id]
+            assert parent.name == f"outer{i}"
+            assert parent.trace_id == s.trace_id
+        else:
+            assert s.parent_id is None  # every outer is its own trace root
+
+
+def test_tracer_max_spans_drop_counter(monkeypatch):
+    from kubeml_tpu.utils import tracing
+
+    monkeypatch.setattr(tracing, "MAX_SPANS", 5)
+    t = Tracer(enabled=True)
+    for i in range(9):
+        t.record(f"s{i}", 0.01)
+    assert len(t.spans()) == 5
+    assert t.dropped == 4
+    # ring semantics: the OLDEST spans evicted, so a long-lived service
+    # still records new tasks' traces after weeks of server spans
+    assert [s.name for s in t.spans()] == ["s4", "s5", "s6", "s7", "s8"]
+    t.clear()
+    assert t.dropped == 0 and t.spans() == []
+
+
+# --- trace identity / W3C propagation ---
+
+
+def test_traceparent_round_trip():
+    from kubeml_tpu.utils.tracing import (TraceContext, new_span_id,
+                                          new_trace_id, parse_traceparent)
+
+    ctx = TraceContext(new_trace_id(), new_span_id())
+    header = ctx.traceparent()
+    assert header == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+    assert parse_traceparent(header) == ctx
+    # malformed/invalid inputs decode to None, never raise
+    for bad in (None, "", "garbage", "00-zz-xx-01",
+                "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # zero trace id
+                "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # zero span id
+                "ff-" + "a" * 32 + "-" + "b" * 16 + "-01"):  # version ff
+        assert parse_traceparent(bad) is None
+
+
+def test_span_identity_nesting_and_inbound_context():
+    from kubeml_tpu.utils import tracing
+
+    t = Tracer(enabled=True, service="svc")
+    with t.span("root") as root:
+        with t.span("child") as child:
+            pass
+    assert root.trace_id == child.trace_id
+    assert child.parent_id == root.span_id and root.parent_id is None
+    # an inbound context (the HTTP server binding a traceparent) parents the
+    # next span even though no local span is open
+    ctx = tracing.TraceContext(tracing.new_trace_id(), tracing.new_span_id())
+    with tracing.use_context(ctx):
+        assert tracing.current_context() == ctx
+        hdrs = tracing.trace_headers({"X-Other": "1"})
+        assert hdrs["traceparent"] == ctx.traceparent()
+        assert hdrs["X-Other"] == "1"
+        with t.span("served") as s:
+            pass
+    assert s.trace_id == ctx.trace_id and s.parent_id == ctx.span_id
+    assert tracing.current_context() is None
+    assert tracing.trace_headers() == {}
+
+
+def test_two_process_propagation(tmp_path):
+    """A child PROCESS handed a traceparent must record spans carrying the
+    parent's trace_id with parent_id pointing at the parent span — the
+    cross-process stitch the control plane relies on."""
+    import subprocess
+    import sys
+
+    from kubeml_tpu.utils import tracing
+
+    t = Tracer(enabled=True, service="parent")
+    child_script = (
+        "import json, sys\n"
+        "from kubeml_tpu.utils import tracing\n"
+        "t = tracing.Tracer(enabled=True, service='child')\n"
+        "ctx = tracing.parse_traceparent(sys.argv[1])\n"
+        "with tracing.use_context(ctx):\n"
+        "    with t.span('child.work', job='j1'):\n"
+        "        pass\n"
+        "print(json.dumps([s.to_dict() for s in t.spans()]))\n"
+    )
+    with t.span("parent.request", job="j1") as parent_span:
+        header = tracing.current_context().traceparent()
+        out = subprocess.run(
+            [sys.executable, "-c", child_script, header],
+            capture_output=True, text=True, timeout=120, check=True,
+        )
+    (child,) = json.loads(out.stdout)
+    assert child["trace_id"] == parent_span.trace_id
+    assert child["parent_id"] == parent_span.span_id
+    assert child["service"] == "child"
+    assert child["pid"] != parent_span.to_dict()["pid"]
+    # the merged chrome export renders one process row per service
+    merged = tracing.merge_chrome_trace(
+        [parent_span.to_dict(), child])
+    rows = [e["args"]["name"] for e in merged["traceEvents"]
+            if e["ph"] == "M"]
+    assert rows == ["parent", "child"]
+
+
 # --- FailureInjector ---
 
 
