@@ -76,6 +76,42 @@ class Config:
         default_factory=lambda: _env_bool("KUBEML_TENSOR_SOCKETS", True)
     )
 
+    # --- control-plane resilience (utils.resilience) ---
+    # seconds a job thread waits for the scheduler's epoch-end parallelism
+    # answer before keeping its current parallelism (the reference blocks
+    # forever on schedulerCh; a timeout keeps a dead scheduler from wedging
+    # training)
+    update_timeout: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_UPDATE_TIMEOUT", "30"))
+    )
+    # connect-phase timeout for every internal hop: a peer that can't even
+    # be reached must fail in seconds, not hang for the full read timeout
+    http_connect_timeout: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_CONNECT_TIMEOUT", "3.05"))
+    )
+    # bounded retries for idempotent / idempotency-keyed internal calls:
+    # total attempts, exponential backoff base and cap (seconds, jittered)
+    retry_attempts: int = field(default_factory=lambda: _env_int("KUBEML_RETRY_ATTEMPTS", 3))
+    retry_backoff: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_RETRY_BACKOFF", "0.1"))
+    )
+    retry_backoff_max: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_RETRY_BACKOFF_MAX", "2.0"))
+    )
+    # per-destination retry budget: retries are throttled to ~this fraction
+    # of live traffic, so a hard outage degrades instead of amplifying
+    retry_budget: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_RETRY_BUDGET", "0.2"))
+    )
+    # circuit breaker: consecutive transport failures that open a
+    # destination's circuit, and the open-state cooldown before the
+    # half-open probe
+    breaker_threshold: int = field(
+        default_factory=lambda: _env_int("KUBEML_BREAKER_THRESHOLD", 5))
+    breaker_cooldown: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_BREAKER_COOLDOWN", "5.0"))
+    )
+
     # --- function execution guardrails (reference cmd/function.go:234-262:
     # per-function concurrency 50, execution timeout 1000s) ---
     # seconds a user-code call (function load, traced user module, a job
@@ -139,6 +175,17 @@ class Config:
     # pressure (measured neutral on chip; kept for drain phases)
     serving_pressure_sizing: bool = field(
         default_factory=lambda: _env_bool("KUBEML_SERVING_PRESSURE_SIZING", True))
+    # serving overload protection: queued decode rows past this depth are
+    # refused at admission with 429 + Retry-After (0 = unbounded). The
+    # serving path must shed load under a burst, never queue unboundedly.
+    serving_queue_limit: int = field(
+        default_factory=lambda: _env_int("KUBEML_SERVING_QUEUE_LIMIT", 256))
+    # what happens at the limit: "reject" 429s the NEW request;
+    # "oldest" sheds the longest-queued request instead (its waiter gets the
+    # 429) and admits the new one — freshest-work-wins under sustained
+    # overload, bounding queue wait instead of queue depth alone
+    serving_shed_policy: str = field(
+        default_factory=lambda: os.environ.get("KUBEML_SERVING_SHED", "reject"))
     # SHARDED serving: axis spec like "tp=2" — finished (sharded) checkpoints
     # restore straight onto this mesh and the batcher runs one SPMD decode
     # program over it, so a model too big for one chip still serves. Empty

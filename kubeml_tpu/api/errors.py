@@ -100,14 +100,43 @@ class NotReadyError(KubeMLError):
     status_code = 503
 
 
+class OverloadedError(KubeMLError):
+    """Serving admission refused under overload: 429 with a Retry-After hint
+    (utils.httpd adds the header from ``retry_after``). Clients must back off
+    — the resilience retry loop deliberately does not retry 429s. The hint
+    travels IN the envelope so a multi-hop proxy chain (controller →
+    scheduler → PS → runner) reconstructs it at every hop instead of
+    dropping the header."""
+
+    status_code = 429
+
+    def __init__(self, message: str = "", retry_after: float = 1.0):
+        super().__init__(message or "server overloaded, retry later")
+        self.retry_after = float(retry_after)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = super().to_dict()
+        d["retry_after"] = self.retry_after
+        return d
+
+
 def error_from_envelope(body: bytes | str, default_code: int = 500) -> KubeMLError:
     """Parse a ``{"error", "code"}`` envelope from a failed HTTP response into a
-    typed error (reference: ml/pkg/error/error.go:36-59 CheckFunctionError)."""
+    typed error (reference: ml/pkg/error/error.go:36-59 CheckFunctionError).
+    A 429 envelope rebuilds as :class:`OverloadedError` so its ``retry_after``
+    survives proxy hops end to end."""
+    retry_after = None
     try:
         d = json.loads(body)
         msg = d.get("error", "unknown error")
         code = int(d.get("code", default_code))
+        retry_after = d.get("retry_after")
     except (ValueError, TypeError, AttributeError):
         msg = body.decode(errors="replace") if isinstance(body, bytes) else str(body)
         code = default_code
+    if code == 429:
+        try:
+            return OverloadedError(msg, retry_after=float(retry_after or 1.0))
+        except (TypeError, ValueError):
+            return OverloadedError(msg)
     return KubeMLError(msg, code)

@@ -47,13 +47,17 @@ class _Networks:
 
     def train(self, request: TrainRequest) -> str:
         return _check(
-            requests.post(f"{self.c.url}/train", json=request.to_dict(), timeout=self.c.timeout)
+            requests.post(f"{self.c.url}/train", json=request.to_dict(),
+                          timeout=requests.timeouts(self.c.timeout),
+                          idempotency_key=True)
         )["id"]
 
     def infer(self, model_id: str, data: Any) -> list:
         body = InferRequest(model_id=model_id, data=np.asarray(data).tolist())
         return _check(
-            requests.post(f"{self.c.url}/infer", json=body.to_dict(), timeout=self.c.timeout)
+            requests.post(f"{self.c.url}/infer", json=body.to_dict(),
+                          timeout=requests.timeouts(self.c.timeout),
+                          retryable=True)
         )["predictions"]
 
     def generate(self, model_id: str, prompts: Any, *, max_new_tokens: int = 32,
@@ -79,7 +83,8 @@ class _Networks:
             import json as _json
 
             r = requests.post(f"{self.c.url}/generate", json=body.to_dict(),
-                              timeout=timeout, stream=True)
+                              timeout=requests.timeouts(timeout), stream=True,
+                              retryable=True)
             if r.status_code >= 400:
                 from ..api.errors import error_from_envelope
 
@@ -96,7 +101,8 @@ class _Networks:
             return lines()
         return _check(
             requests.post(f"{self.c.url}/generate", json=body.to_dict(),
-                          timeout=timeout))
+                          timeout=requests.timeouts(timeout),
+                          retryable=True))
 
 
 class _Datasets:
@@ -113,7 +119,9 @@ class _Datasets:
         return DatasetSummary.from_dict(
             _check(
                 requests.post(
-                    f"{self.c.url}/dataset/{name}", files=files, timeout=self.c.timeout
+                    f"{self.c.url}/dataset/{name}", files=files,
+                    timeout=requests.timeouts(self.c.timeout),
+                    idempotency_key=True,
                 )
             )
         )
@@ -139,26 +147,27 @@ class _Datasets:
             files["train-bpe"] = (None, str(int(train_bpe)))
         return _check(
             requests.post(f"{self.c.url}/dataset/{name}", files=files,
-                          timeout=max(self.c.timeout, 300)))
+                          timeout=requests.timeouts(max(self.c.timeout, 300)),
+                          idempotency_key=True))
 
     def tokenizer(self, name: str) -> dict:
         """The dataset's tokenizer asset (raises 404 for byte-level)."""
         return _check(requests.get(f"{self.c.url}/dataset/{name}/tokenizer",
-                                   timeout=self.c.timeout))
+                                   timeout=requests.timeouts(self.c.timeout)))
 
     def get(self, name: str) -> DatasetSummary:
         return DatasetSummary.from_dict(
-            _check(requests.get(f"{self.c.url}/dataset/{name}", timeout=self.c.timeout))
+            _check(requests.get(f"{self.c.url}/dataset/{name}", timeout=requests.timeouts(self.c.timeout)))
         )
 
     def list(self) -> List[DatasetSummary]:
         return [
             DatasetSummary.from_dict(d)
-            for d in _check(requests.get(f"{self.c.url}/dataset", timeout=self.c.timeout))
+            for d in _check(requests.get(f"{self.c.url}/dataset", timeout=requests.timeouts(self.c.timeout)))
         ]
 
     def delete(self, name: str) -> None:
-        _check(requests.delete(f"{self.c.url}/dataset/{name}", timeout=self.c.timeout))
+        _check(requests.delete(f"{self.c.url}/dataset/{name}", timeout=requests.timeouts(self.c.timeout)))
 
 
 class _Tasks:
@@ -168,21 +177,21 @@ class _Tasks:
     def list(self) -> List[TrainTask]:
         return [
             TrainTask.from_dict(d)
-            for d in _check(requests.get(f"{self.c.url}/tasks", timeout=self.c.timeout))
+            for d in _check(requests.get(f"{self.c.url}/tasks", timeout=requests.timeouts(self.c.timeout)))
         ]
 
     def stop(self, job_id: str) -> None:
-        _check(requests.delete(f"{self.c.url}/tasks/{job_id}", timeout=self.c.timeout))
+        _check(requests.delete(f"{self.c.url}/tasks/{job_id}", timeout=requests.timeouts(self.c.timeout)))
 
     def prune(self) -> int:
-        return _check(requests.delete(f"{self.c.url}/tasks", timeout=self.c.timeout))["pruned"]
+        return _check(requests.delete(f"{self.c.url}/tasks", timeout=requests.timeouts(self.c.timeout)))["pruned"]
 
     def trace(self, job_id: str) -> dict:
         """The merged distributed trace of a (completed) task:
         ``{"task_id", "trace_ids", "spans": [span dicts]}`` — render with
         ``kubeml_tpu.utils.tracing.merge_chrome_trace``."""
         return _check(
-            requests.get(f"{self.c.url}/tasks/{job_id}/trace", timeout=self.c.timeout)
+            requests.get(f"{self.c.url}/tasks/{job_id}/trace", timeout=requests.timeouts(self.c.timeout))
         )
 
 
@@ -192,20 +201,20 @@ class _Histories:
 
     def get(self, job_id: str) -> History:
         return History.from_dict(
-            _check(requests.get(f"{self.c.url}/history/{job_id}", timeout=self.c.timeout))
+            _check(requests.get(f"{self.c.url}/history/{job_id}", timeout=requests.timeouts(self.c.timeout)))
         )
 
     def list(self) -> List[History]:
         return [
             History.from_dict(d)
-            for d in _check(requests.get(f"{self.c.url}/history", timeout=self.c.timeout))
+            for d in _check(requests.get(f"{self.c.url}/history", timeout=requests.timeouts(self.c.timeout)))
         ]
 
     def delete(self, job_id: str) -> None:
-        _check(requests.delete(f"{self.c.url}/history/{job_id}", timeout=self.c.timeout))
+        _check(requests.delete(f"{self.c.url}/history/{job_id}", timeout=requests.timeouts(self.c.timeout)))
 
     def prune(self) -> int:
-        return _check(requests.delete(f"{self.c.url}/history", timeout=self.c.timeout))["pruned"]
+        return _check(requests.delete(f"{self.c.url}/history", timeout=requests.timeouts(self.c.timeout)))["pruned"]
 
 
 class _Functions:
@@ -220,18 +229,19 @@ class _Functions:
                 f"{self.c.url}/function/{name}",
                 data=source.encode(),
                 headers={"Content-Type": "text/x-python"},
-                timeout=self.c.timeout,
+                timeout=requests.timeouts(self.c.timeout),
+                idempotency_key=True,
             )
         )
 
     def get(self, name: str) -> dict:
-        return _check(requests.get(f"{self.c.url}/function/{name}", timeout=self.c.timeout))
+        return _check(requests.get(f"{self.c.url}/function/{name}", timeout=requests.timeouts(self.c.timeout)))
 
     def list(self) -> List[dict]:
-        return _check(requests.get(f"{self.c.url}/function", timeout=self.c.timeout))
+        return _check(requests.get(f"{self.c.url}/function", timeout=requests.timeouts(self.c.timeout)))
 
     def delete(self, name: str) -> None:
-        _check(requests.delete(f"{self.c.url}/function/{name}", timeout=self.c.timeout))
+        _check(requests.delete(f"{self.c.url}/function/{name}", timeout=requests.timeouts(self.c.timeout)))
 
 
 class _Checkpoints:
@@ -241,12 +251,12 @@ class _Checkpoints:
     def list(self, job_id: str) -> List[str]:
         """Checkpoint tags of one job."""
         return _check(
-            requests.get(f"{self.c.url}/checkpoint/{job_id}", timeout=self.c.timeout)
+            requests.get(f"{self.c.url}/checkpoint/{job_id}", timeout=requests.timeouts(self.c.timeout))
         )["checkpoints"]
 
     def list_jobs(self) -> dict:
         """All jobs with checkpoints -> their tags."""
-        return _check(requests.get(f"{self.c.url}/checkpoint", timeout=self.c.timeout))
+        return _check(requests.get(f"{self.c.url}/checkpoint", timeout=requests.timeouts(self.c.timeout)))
 
     def export(self, job_id: str, dest: Union[str, Path], epoch: Optional[int] = None,
                tag: Optional[str] = None) -> Path:
@@ -256,7 +266,7 @@ class _Checkpoints:
         if tag is not None:
             params["tag"] = tag
         resp = requests.get(
-            f"{self.c.url}/checkpoint/{job_id}/export", params=params, timeout=self.c.timeout
+            f"{self.c.url}/checkpoint/{job_id}/export", params=params, timeout=requests.timeouts(self.c.timeout)
         )
         if resp.status_code >= 400:
             raise error_from_envelope(resp.content, resp.status_code)
@@ -272,13 +282,14 @@ class _Checkpoints:
         ``final-int8`` tag; int8-configured serving prefers it)."""
         return _check(requests.post(
             f"{self.c.url}/checkpoint/{job_id}/quantize",
-            timeout=max(self.c.timeout, 600)))
+            timeout=requests.timeouts(max(self.c.timeout, 600)),
+            idempotency_key=True))
 
     def delete(self, job_id: str, tag: Optional[str] = None) -> None:
         params = {"tag": tag} if tag else {}
         _check(
             requests.delete(
-                f"{self.c.url}/checkpoint/{job_id}", params=params, timeout=self.c.timeout
+                f"{self.c.url}/checkpoint/{job_id}", params=params, timeout=requests.timeouts(self.c.timeout)
             )
         )
 
@@ -340,6 +351,7 @@ class KubemlClient:
 
     def health(self) -> bool:
         try:
-            return requests.get(f"{self.url}/health", timeout=5).status_code == 200
+            return requests.get(f"{self.url}/health",
+                                timeout=requests.timeouts(5)).status_code == 200
         except requests.RequestException:
             return False

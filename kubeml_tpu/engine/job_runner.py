@@ -266,7 +266,9 @@ class JobRunner:
 
     def _epoch_end(self, state) -> int:
         """Reference loop shape: job -> scheduler /job; answer arrives on /update
-        (via PS). Timeout keeps a dead scheduler from wedging training."""
+        (via PS). Timeout keeps a dead scheduler from wedging training. The
+        epoch-end POST is idempotency-keyed so a retried delivery cannot
+        double-enqueue the same re-evaluation."""
         from ..api.types import TrainTask
         from ..utils import traced_http as requests
         from ..utils import tracing
@@ -278,14 +280,20 @@ class JobRunner:
         task = TrainTask(job_id=self.job_id, parameters=self.job.request, state=state,
                          trace_parent=ctx.traceparent() if ctx else "")
         try:
-            requests.post(f"{self.cfg.scheduler_url}/job", json=task.to_dict(), timeout=10)
+            requests.post(f"{self.cfg.scheduler_url}/job", json=task.to_dict(),
+                          timeout=requests.timeouts(10),
+                          idempotency_key=True)
         except requests.RequestException as e:
             log.warning("job %s: scheduler unreachable (%s); keeping parallelism",
                         self.job_id, e)
             return state.parallelism
         try:
-            if not box[0].wait(30.0):
-                log.warning("job %s: scheduler update timed out", self.job_id)
+            if not box[0].wait(self.cfg.update_timeout):
+                log.warning(
+                    "job %s: scheduler at %s answered no parallelism update "
+                    "within %.0fs (KUBEML_UPDATE_TIMEOUT); keeping "
+                    "parallelism", self.job_id, self.cfg.scheduler_url,
+                    self.cfg.update_timeout)
                 return state.parallelism
             if self.job.stop_event.is_set():
                 return state.parallelism
@@ -300,18 +308,24 @@ class JobRunner:
 
         try:
             requests.post(f"{self.cfg.ps_url}/metrics/{self.job_id}",
-                          json=update.to_dict(), timeout=5)
+                          json=update.to_dict(),
+                          timeout=requests.timeouts(5),
+                          idempotency_key=True)
         except requests.RequestException:
             log.debug("job %s: metrics push failed (PS down?)", self.job_id)
 
     def _notify_ps_finished(self) -> None:
         from ..utils import traced_http as requests
 
+        # keyed: the PS pops the job record on first delivery, so a retried
+        # finish callback must replay, not 404 (the raced-runner dedup the
+        # PS already needed, now explicit on the wire)
         try:
             requests.post(
                 f"{self.cfg.ps_url}/finish/{self.job_id}",
                 json={"error": self.exit_error, "status": self.status},
-                timeout=10,
+                timeout=requests.timeouts(10),
+                idempotency_key=True,
             )
         except requests.RequestException as e:
             log.warning("job %s: PS finish notification failed: %s", self.job_id, e)

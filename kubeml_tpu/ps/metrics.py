@@ -116,6 +116,15 @@ SERVING_COUNTERS = {
         "requests_canceled", "Generate requests explicitly canceled"),
     "kubeml_serving_requests_failed_total": (
         "requests_failed", "Generate requests failed by an engine fault"),
+    "kubeml_serving_requests_overload_total": (
+        "requests_overload",
+        "Generate requests refused 429 at the queue admission limit"),
+    "kubeml_serving_requests_shed_total": (
+        "requests_shed",
+        "Queued generate requests shed oldest-first under overload"),
+    "kubeml_serving_deadline_expired_total": (
+        "requests_deadline_expired",
+        "Queued generate requests failed on an expired deadline"),
     "kubeml_serving_admission_waves_total": (
         "admission_waves", "Batched prefill+admit programs dispatched"),
     "kubeml_serving_chunks_total": ("chunks",
@@ -151,6 +160,8 @@ SERVING_GAUGES = {
         "tokens_per_second", "Sustained decode rate (10s window)"),
     "kubeml_serving_queue_depth": ("queue_depth",
                                    "Rows waiting for a decode slot"),
+    "kubeml_serving_queue_limit": (
+        "queue_limit", "Admission limit on queued rows (0 = unbounded)"),
     "kubeml_serving_slots_busy": ("slots_busy", "Occupied decode slots"),
     "kubeml_serving_slots_total": ("slots_total", "Configured decode slots"),
     "kubeml_serving_weight_bytes": (
@@ -297,6 +308,16 @@ class MetricsRegistry:
                 if hist_snap:
                     lines.extend(Histogram.render_snapshot(
                         metric, hist_snap, "model", model))
+        # control-plane resilience counters (utils.resilience): retries,
+        # breaker state/opens, deadline rejections, chaos injections —
+        # process-local, rendered on the same exposition so one scrape sees
+        # the whole fault-handling picture
+        try:
+            from ..utils import resilience
+
+            lines.extend(resilience.render_metrics())
+        except Exception:  # exposition must never fail the scrape
+            pass
         return "\n".join(lines) + "\n"
 
     def get(self, metric: str, job_id: str) -> float:

@@ -50,7 +50,9 @@ DECODER_CACHE_SIZE = 2
 
 # Seconds the job thread waits for the scheduler's parallelism answer before
 # keeping its current parallelism (the reference blocks forever on schedulerCh;
-# a timeout keeps a dead scheduler from wedging training).
+# a timeout keeps a dead scheduler from wedging training). Config-driven:
+# Config.update_timeout / KUBEML_UPDATE_TIMEOUT; this constant is the
+# documented default only.
 UPDATE_TIMEOUT = 30.0
 
 
@@ -288,11 +290,28 @@ class ParameterServer:
             with self._lock:
                 placeholder.proc = proc
                 placeholder.url = url
-            # hand the task over with retries (reference api.go:190-207)
+            # hand the task over with retries (reference api.go:190-207);
+            # the idempotency key makes redelivery safe — a /start whose
+            # response was lost replays from the runner's record instead of
+            # bouncing off "already started"
+            import uuid
+
             last = None
+            start_key = uuid.uuid4().hex
             for attempt in range(10):
                 try:
-                    r = requests.post(f"{url}/start", json=task.to_dict(), timeout=30)
+                    # retryable=False: THIS loop is the retry schedule
+                    # (reference-parity backoff) — layering the policy-stack
+                    # retries under it would compound to 30 wire attempts.
+                    # use_breaker=False: connection-refused during a normal
+                    # runner boot must not open a breaker that then eats the
+                    # later attempts the boot needs (the dest is this job's
+                    # fresh ephemeral port — nothing to protect). The shared
+                    # key still makes every redelivery replay-safe.
+                    r = requests.post(f"{url}/start", json=task.to_dict(),
+                                      timeout=requests.timeouts(30),
+                                      idempotency_key=start_key,
+                                      retryable=False, use_breaker=False)
                     if r.status_code < 400:
                         break
                     last = r.text
@@ -668,9 +687,12 @@ class ParameterServer:
             task = record.task
         task.state = state
         self.scheduler.update_job(task)
-        if not box.event.wait(UPDATE_TIMEOUT):
-            log.warning("job %s: scheduler update timed out, keeping parallelism %d",
-                        job_id, state.parallelism)
+        timeout = self.cfg.update_timeout
+        if not box.event.wait(timeout):
+            log.warning(
+                "job %s: scheduler at %s answered no parallelism update "
+                "within %.0fs (KUBEML_UPDATE_TIMEOUT); keeping parallelism %d",
+                job_id, self.cfg.scheduler_url, timeout, state.parallelism)
             return state.parallelism
         return box.parallelism
 
@@ -687,7 +709,9 @@ class ParameterServer:
 
             try:
                 requests.post(f"{record.url}/update",
-                              json={"parallelism": parallelism}, timeout=10)
+                              json={"parallelism": parallelism},
+                              timeout=requests.timeouts(10),
+                              idempotency_key=True)
             except requests.RequestException as e:
                 log.warning("job %s: update delivery failed: %s", job_id, e)
             return
@@ -749,7 +773,8 @@ class ParameterServer:
             from ..utils import traced_http as requests
 
             try:
-                r = requests.delete(f"{record.url}/stop", timeout=10)
+                r = requests.delete(f"{record.url}/stop",
+                                    timeout=requests.timeouts(10))
             except requests.RequestException as e:
                 raise KubeMLError(f"job {job_id} runner unreachable: {e}", 502)
             if r.status_code >= 400:
@@ -812,7 +837,8 @@ class ParameterServer:
 
             from ..api.errors import error_from_envelope
 
-            r = requests.post(f"{record.url}/infer", json={"data": data}, timeout=60)
+            r = requests.post(f"{record.url}/infer", json={"data": data},
+                              timeout=requests.timeouts(60), retryable=True)
             if r.status_code >= 400:
                 raise error_from_envelope(r.content, r.status_code)
             return r.json()["predictions"]
@@ -852,7 +878,8 @@ class ParameterServer:
             # transport failures
             fwd = {**req.to_dict(), "stream": False}
             r = requests.post(f"{record.url}/generate", json=fwd,
-                              timeout=generate_timeout(req))
+                              timeout=requests.timeouts(generate_timeout(req)),
+                              retryable=True)
             if r.status_code >= 400:
                 raise error_from_envelope(r.content, r.status_code)
             return self._maybe_stream(r.json(), req)
@@ -956,7 +983,9 @@ class ParameterServer:
             int8_matmul=self.cfg.int8_matmul,
             pipeline_depth=self.cfg.serving_pipeline,
             fetchers=self.cfg.serving_fetchers,
-            pressure_sizing=self.cfg.serving_pressure_sizing)
+            pressure_sizing=self.cfg.serving_pressure_sizing,
+            queue_limit=self.cfg.serving_queue_limit,
+            shed_policy=self.cfg.serving_shed_policy)
         stale = []
         with self._lock:
             # double-checked: a racing thread may have built one meanwhile —

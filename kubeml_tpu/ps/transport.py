@@ -111,21 +111,29 @@ def _check(resp: requests.Response):
 
 
 class PSClient:
-    """Remote PS with the method surface the scheduler/controller use."""
+    """Remote PS with the method surface the scheduler/controller use.
+    Explicit (connect, read) timeout tuples on every hop; the non-idempotent
+    POSTs carry idempotency keys so retried deliveries replay server-side."""
 
     def __init__(self, url: str, timeout: float = 60.0):
         self.url = url.rstrip("/")
         self.timeout = timeout
 
+    def _timeout(self, read=None) -> tuple:
+        return requests.timeouts(read if read is not None else self.timeout)
+
     def start_task(self, task: TrainTask) -> None:
-        _check(requests.post(f"{self.url}/start", json=task.to_dict(), timeout=self.timeout))
+        _check(requests.post(f"{self.url}/start", json=task.to_dict(),
+                             timeout=self._timeout(),
+                             idempotency_key=True))
 
     def update_task(self, job_id: str, parallelism: int) -> None:
         _check(
             requests.post(
                 f"{self.url}/update/{job_id}",
                 json={"parallelism": parallelism},
-                timeout=self.timeout,
+                timeout=self._timeout(),
+                idempotency_key=True,
             )
         )
 
@@ -134,29 +142,34 @@ class PSClient:
             requests.post(
                 f"{self.url}/infer",
                 json={"model_id": model_id, "data": data},
-                timeout=self.timeout,
+                timeout=self._timeout(), retryable=True,
             )
         )["predictions"]
 
     def stop_task(self, job_id: str) -> None:
-        _check(requests.delete(f"{self.url}/stop/{job_id}", timeout=self.timeout))
+        _check(requests.delete(f"{self.url}/stop/{job_id}",
+                               timeout=self._timeout()))
 
     def list_tasks(self):
-        return [TrainTask.from_dict(d) for d in _check(requests.get(f"{self.url}/tasks", timeout=self.timeout))]
+        return [TrainTask.from_dict(d) for d in _check(
+            requests.get(f"{self.url}/tasks", timeout=self._timeout()))]
 
     def metrics_text(self) -> str:
-        return requests.get(f"{self.url}/metrics", timeout=self.timeout).text
+        return requests.get(f"{self.url}/metrics",
+                            timeout=self._timeout()).text
 
     def post_trace(self, task_id: str, spans: list) -> None:
         _check(requests.post(f"{self.url}/traces/{task_id}",
-                             json={"spans": spans}, timeout=self.timeout))
+                             json={"spans": spans}, timeout=self._timeout(),
+                             idempotency_key=True))
 
     def get_trace(self, task_id: str) -> dict:
         return _check(requests.get(f"{self.url}/traces/{task_id}",
-                                   timeout=self.timeout))
+                                   timeout=self._timeout()))
 
     def health(self) -> bool:
         try:
-            return requests.get(f"{self.url}/health", timeout=5).status_code == 200
+            return requests.get(f"{self.url}/health",
+                                timeout=self._timeout(5)).status_code == 200
         except requests.RequestException:
             return False

@@ -76,15 +76,24 @@ def _check(resp: requests.Response):
 
 
 class SchedulerClient:
-    """Remote scheduler with the Scheduler method surface the PS/controller use."""
+    """Remote scheduler with the Scheduler method surface the PS/controller
+    use. Every hop carries an explicit (connect, read) timeout tuple — a
+    peer that cannot even be dialed fails in seconds, not after the full
+    read budget — and the non-idempotent submits are idempotency-keyed so
+    the resilience retry loop can redeliver them safely."""
 
     def __init__(self, url: str, timeout: float = 30.0):
         self.url = url.rstrip("/")
         self.timeout = timeout
 
+    def _timeout(self, read: Optional[float] = None) -> tuple:
+        return requests.timeouts(read if read is not None else self.timeout)
+
     def submit_train(self, request: TrainRequest) -> str:
         return _check(
-            requests.post(f"{self.url}/train", json=request.to_dict(), timeout=self.timeout)
+            requests.post(f"{self.url}/train", json=request.to_dict(),
+                          timeout=self._timeout(),
+                          idempotency_key=True)
         )["id"]
 
     def infer(self, model_id: str, data):
@@ -92,7 +101,7 @@ class SchedulerClient:
             requests.post(
                 f"{self.url}/infer",
                 json=InferRequest(model_id=model_id, data=data).to_dict(),
-                timeout=self.timeout,
+                timeout=self._timeout(), retryable=True,
             )
         )
         return r["predictions"]
@@ -107,7 +116,8 @@ class SchedulerClient:
             from ..api.errors import error_from_envelope
 
             r = requests.post(f"{self.url}/generate", json=req.to_dict(),
-                              timeout=timeout, stream=True)
+                              timeout=self._timeout(timeout), stream=True,
+                              retryable=True)
             if r.status_code >= 400:
                 raise error_from_envelope(r.content, r.status_code)
 
@@ -122,17 +132,21 @@ class SchedulerClient:
             return lines()
         return _check(
             requests.post(f"{self.url}/generate", json=req.to_dict(),
-                          timeout=timeout)
+                          timeout=self._timeout(timeout), retryable=True)
         )
 
     def update_job(self, task: TrainTask) -> None:
-        _check(requests.post(f"{self.url}/job", json=task.to_dict(), timeout=self.timeout))
+        _check(requests.post(f"{self.url}/job", json=task.to_dict(),
+                             timeout=self._timeout(),
+                             idempotency_key=True))
 
     def finish_job(self, job_id: str) -> None:
-        _check(requests.delete(f"{self.url}/finish/{job_id}", timeout=self.timeout))
+        _check(requests.delete(f"{self.url}/finish/{job_id}",
+                               timeout=self._timeout()))
 
     def health(self) -> bool:
         try:
-            return requests.get(f"{self.url}/health", timeout=5).status_code == 200
+            return requests.get(f"{self.url}/health",
+                                timeout=self._timeout(5)).status_code == 200
         except requests.RequestException:
             return False

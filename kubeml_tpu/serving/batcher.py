@@ -237,6 +237,9 @@ class _Entry:
     submitted_at: float = 0.0   # monotonic; serving telemetry (stats.py)
     first_token_at: float = 0.0  # 0 until the first token lands
     aborted: bool = False        # timeout/cancel already counted
+    # absolute request deadline (unix seconds; utils.resilience binding) —
+    # a row still QUEUED past it fails fast with 504 instead of taking a slot
+    deadline: Optional[float] = None
 
     def finished(self) -> bool:
         return all(r.done for r in self.rows)
@@ -268,7 +271,9 @@ class BatchingDecoder:
                  mesh=None, quantize: str = "",
                  int8_matmul: Optional[bool] = None,
                  fetchers: Optional[int] = None,
-                 pressure_sizing: Optional[bool] = None):
+                 pressure_sizing: Optional[bool] = None,
+                 queue_limit: Optional[int] = None,
+                 shed_policy: Optional[str] = None):
         cap = getattr(module, "max_len", None)
         if cap is None:
             raise GenerationInputError(
@@ -310,6 +315,15 @@ class BatchingDecoder:
         self.pressure_sizing = bool(
             pressure_sizing if pressure_sizing is not None
             else cfg.serving_pressure_sizing)
+        # overload protection: queued rows past queue_limit are refused at
+        # admission with 429 + Retry-After (0 = unbounded); shed_policy
+        # "oldest" instead sheds the longest-queued request to admit the new
+        # one — under sustained overload the queue must bound WAIT, not just
+        # depth (an unbounded queue serves nobody within their deadline)
+        self.queue_limit = int(queue_limit if queue_limit is not None
+                               else cfg.serving_queue_limit)
+        self.shed_policy = str(shed_policy if shed_policy is not None
+                               else cfg.serving_shed_policy)
         self.name = name
         # weight-only int8 (serving/quant.py): halves the per-step weight
         # HBM traffic and footprint; the dequantize is traced inside the
@@ -634,10 +648,13 @@ class BatchingDecoder:
                     f" - 1 exceeds the model's max_len ({self.max_len})", 400)
         base_key = (jax.random.PRNGKey(req.seed) if req.seed is not None
                     else None)
+        from ..utils import resilience
+
         rows = []
         entry = _Entry(rows=rows, max_new=req.max_new_tokens,
                        stream_q=queue.Queue() if req.stream else None,
-                       submitted_at=time.monotonic())
+                       submitted_at=time.monotonic(),
+                       deadline=resilience.current_deadline())
         for i in range(B):
             key = (np.asarray(jax.random.fold_in(base_key, i))
                    if base_key is not None
@@ -653,6 +670,25 @@ class BatchingDecoder:
         with self._cond:
             if self._closed or self._retired:
                 raise DecoderClosed()
+            # admission limit gates on QUEUE pressure: a batch wider than the
+            # limit still admits into an otherwise-empty queue (it was
+            # serviceable before the limit existed and a retry could never
+            # succeed), so the bound is limit + one batch, not limit alone
+            if (self.queue_limit > 0 and self._pending
+                    and len(self._pending) + len(rows) > self.queue_limit):
+                if self.shed_policy == "oldest":
+                    self._shed_oldest_locked(
+                        len(self._pending) + len(rows) - self.queue_limit)
+                if (self._pending and len(self._pending) + len(rows)
+                        > self.queue_limit):
+                    from ..api.errors import OverloadedError
+
+                    self.stats.overloaded()
+                    raise OverloadedError(
+                        f"decode queue at its admission limit "
+                        f"({len(self._pending)}/{self.queue_limit} rows "
+                        f"queued; KUBEML_SERVING_QUEUE_LIMIT)",
+                        retry_after=self._retry_after_hint())
             self._pending.extend(rows)
             self.stats.submitted(1)
             if self._thread is None:
@@ -718,6 +754,106 @@ class BatchingDecoder:
             entry.aborted = True
             return True
 
+    def _fail_entry(self, entry: _Entry, error: Exception, counter) -> None:
+        """Fail one entry's waiters (queued-work shed/expiry path): rows are
+        marked done, the error set, the single telemetry outcome claimed via
+        ``counter``, and both the waiter and any stream consumer released."""
+        for row in entry.rows:
+            row.done = True
+        if entry.error is None:
+            entry.error = error
+        if self._record_outcome(entry):
+            counter()
+        entry.done_evt.set()
+        if entry.stream_q is not None:
+            entry.stream_q.put(None)
+
+    def _shed_oldest_locked(self, need: int) -> int:
+        """Shed the longest-queued entries (oldest-first) to free ``need``
+        queued rows; caller holds ``_cond``. Only entries ALL of whose rows
+        are still queued are sheddable — an entry with rows already in slots
+        keeps its queued siblings (failing it would strand device work).
+        Returns the number of rows freed."""
+        from ..api.errors import OverloadedError
+
+        by_entry: Dict[int, List[_Row]] = {}
+        order: List[_Entry] = []
+        for r in self._pending:
+            if id(r.entry) not in by_entry:
+                order.append(r.entry)
+            by_entry.setdefault(id(r.entry), []).append(r)
+        doomed: List[_Entry] = []
+        freed = 0
+        for entry in order:
+            if freed >= need:
+                break
+            queued = by_entry[id(entry)]
+            if len(queued) != len(entry.rows):
+                continue
+            doomed.append(entry)
+            freed += len(queued)
+        if not doomed:
+            return 0
+        doomed_ids = {id(e) for e in doomed}
+        self._pending = deque(r for r in self._pending
+                              if id(r.entry) not in doomed_ids)
+        hint = self._retry_after_hint()
+        for entry in doomed:
+            self._fail_entry(
+                entry,
+                OverloadedError("request shed from the decode queue under "
+                                "sustained overload (oldest-first)",
+                                retry_after=hint),
+                self.stats.shed)
+        return freed
+
+    def _retry_after_hint(self) -> float:
+        """Retry-After seconds for a 429: roughly how long the current queue
+        takes to drain (depth/slots turns at the recent p50 request
+        latency), clamped to [1, 30]."""
+        with self._cond:
+            depth = len(self._pending)
+        p50 = self.stats.snapshot().get("latency_p50_seconds", 1.0)
+        turns = depth / max(self.slots, 1)
+        return float(min(max(1.0, turns * max(p50, 0.1)), 30.0))
+
+    def _sweep_expired(self) -> None:
+        """Fail queued rows whose request deadline already passed: an
+        expired request must fail fast (504), not occupy a decode slot
+        computing tokens nobody will read. Only entries still fully queued
+        are swept (admitted rows run to completion; the waiter's own timeout
+        covers them). Cold-start compiles get the same allowance wait()
+        grants."""
+        now = time.time()
+        doomed: List[_Entry] = []
+        with self._cond:
+            if not self._pending:
+                return
+            allowance = 0.0 if self._warmed else self.COLD_COMPILE_ALLOWANCE
+            by_entry: Dict[int, List[_Row]] = {}
+            for r in self._pending:
+                by_entry.setdefault(id(r.entry), []).append(r)
+            seen = set()
+            for r in list(self._pending):
+                e = r.entry
+                if id(e) in seen:
+                    continue
+                seen.add(id(e))
+                if (e.deadline is not None
+                        and now > e.deadline + allowance
+                        and len(by_entry[id(e)]) == len(e.rows)):
+                    doomed.append(e)
+            if doomed:
+                doomed_ids = {id(e) for e in doomed}
+                self._pending = deque(r for r in self._pending
+                                      if id(r.entry) not in doomed_ids)
+            for entry in doomed:
+                self._fail_entry(
+                    entry,
+                    KubeMLError("request deadline expired while queued for "
+                                "a decode slot", 504),
+                    self.stats.deadline_expired)
+
     def telemetry(self) -> dict:
         """One snapshot of the decoder's serving metrics: the stats counters
         plus the live queue-depth and slot-occupancy gauges (engine state —
@@ -730,6 +866,7 @@ class BatchingDecoder:
         snap["slots_total"] = float(self.slots)
         snap["slot_occupancy"] = busy / max(self.slots, 1)
         snap["weight_bytes"] = float(self.weight_bytes)
+        snap["queue_limit"] = float(self.queue_limit)
         return snap
 
     @property
@@ -820,6 +957,9 @@ class BatchingDecoder:
                 fetch_q.put(None)
 
         while True:
+            # deadline hygiene before admission: expired queued work fails
+            # fast instead of winning a slot
+            self._sweep_expired()
             with self._cond:
                 while (not self._closed and not self._pending
                        and not self._busy() and process_seq == next_seq):

@@ -44,6 +44,10 @@ class DecoderStats:
         self.requests_timeout = 0     # waiter gave up (504) — rows canceled
         self.requests_canceled = 0    # abandoned by explicit cancel
         self.requests_failed = 0      # engine-side failure surfaced
+        # overload protection (batcher admission limit / shed / deadlines)
+        self.requests_overload = 0    # 429-refused at admission (not queued)
+        self.requests_shed = 0        # shed oldest-first after queueing
+        self.requests_deadline_expired = 0  # expired while queued (504)
         self.tokens_emitted = 0
         self.admission_waves = 0      # batched prefill+admit programs
         self.chunks = 0               # decode chunk programs
@@ -113,6 +117,18 @@ class DecoderStats:
         with self._lock:
             self.requests_canceled += 1
 
+    def overloaded(self) -> None:
+        with self._lock:
+            self.requests_overload += 1
+
+    def shed(self) -> None:
+        with self._lock:
+            self.requests_shed += 1
+
+    def deadline_expired(self) -> None:
+        with self._lock:
+            self.requests_deadline_expired += 1
+
     def failed(self, rows: int = 1) -> None:
         with self._lock:
             self.requests_failed += rows
@@ -151,6 +167,10 @@ class DecoderStats:
                 "requests_timeout": float(self.requests_timeout),
                 "requests_canceled": float(self.requests_canceled),
                 "requests_failed": float(self.requests_failed),
+                "requests_overload": float(self.requests_overload),
+                "requests_shed": float(self.requests_shed),
+                "requests_deadline_expired": float(
+                    self.requests_deadline_expired),
                 "tokens_emitted": float(self.tokens_emitted),
                 "admission_waves": float(self.admission_waves),
                 "chunks": float(self.chunks),

@@ -9,6 +9,13 @@ dependency here; this is a small stdlib ``ThreadingHTTPServer`` with:
 * automatic JSON body/response handling
 * ``KubeMLError`` -> envelope serialization, generic exceptions -> 500 envelope
 * a ``/health`` route on every service by default
+* resilience middleware (utils.resilience): ``x-kubeml-deadline`` enforcement
+  (already-expired requests are rejected with 504 before any work, and the
+  remaining budget binds to the handler thread so downstream hops inherit
+  it), idempotency replay (a retried keyed POST is answered from the recorded
+  response, not re-executed), and env-gated chaos injection
+  (delay/500/connection-reset per route — the network-level complement of
+  engine.failures.FailureInjector's worker masks)
 """
 
 from __future__ import annotations
@@ -17,6 +24,7 @@ import json
 import logging
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -26,6 +34,10 @@ from ..api.errors import KubeMLError
 log = logging.getLogger("kubeml.httpd")
 
 Handler = Callable[["Request"], Any]
+
+
+class _Replayed(Exception):
+    """Control-flow marker: the response came from the replay cache."""
 
 
 class Request:
@@ -53,12 +65,16 @@ class Request:
 
 
 class Response:
-    """Explicit response when a handler needs a non-200 code or raw bytes."""
+    """Explicit response when a handler needs a non-200 code, raw bytes, or
+    extra headers (e.g. ``Retry-After`` on a 429)."""
 
-    def __init__(self, body: Any = None, status: int = 200, content_type: str = "application/json"):
+    def __init__(self, body: Any = None, status: int = 200,
+                 content_type: str = "application/json",
+                 headers: Optional[Dict[str, str]] = None):
         self.body = body
         self.status = status
         self.content_type = content_type
+        self.headers = dict(headers or {})
 
 
 class StreamResponse(Response):
@@ -75,8 +91,12 @@ class StreamResponse(Response):
 
 class Router:
     def __init__(self, name: str):
+        from .resilience import ReplayCache
+
         self.name = name
         self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+        # idempotency replay: keyed POST retries answer from the record
+        self.replay = ReplayCache()
         self.route("GET", "/health", lambda req: {"status": "ok", "service": name})
 
     def route(self, method: str, pattern: str, handler: Handler) -> None:
@@ -129,6 +149,8 @@ class Service:
                 self.send_response(resp.status)
                 self.send_header("Content-Type", resp.content_type)
                 self.send_header("Content-Length", str(len(payload)))
+                for k, v in resp.headers.items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(payload)
 
@@ -156,13 +178,96 @@ class Service:
                     self._chunk(json.dumps({"error": str(e), "code": 500}).encode() + b"\n")
                 self.wfile.write(b"0\r\n\r\n")
 
-            def _handle(self, method: str):
-                from . import tracing
+            def _inject_chaos(self, path: str) -> Optional[str]:
+                """Env-gated chaos middleware (utils.resilience.chaos): maybe
+                delay, and return "error"/"reset" when the request must fail
+                instead of dispatching. Runs BEFORE dispatch so an injected
+                fault never leaves half-applied server state — a retried
+                request is always safe."""
+                from . import resilience
 
+                fault = resilience.chaos().server_fault(path)
+                if fault is None:
+                    return None
+                mode, delay = fault
+                if mode == "delay":
+                    time.sleep(delay)
+                    return None
+                return mode
+
+            def _chaos_reset(self):
+                """Abort the connection without a response: the client sees a
+                reset/EOF mid-exchange (requests.ConnectionError)."""
+                import socket as _socket
+
+                self.close_connection = True
+                try:
+                    self.connection.shutdown(_socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+            def _handle(self, method: str):
+                from . import resilience, tracing
+
+                replayed = False
+                replay_owner = False
+                idem_key = None
                 try:
                     parsed = urlparse(self.path)
                     length = int(self.headers.get("Content-Length") or 0)
                     body = self.rfile.read(length) if length else b""
+                    internal = parsed.path in ("/health", "/metrics")
+                    if not internal:
+                        # chaos first: an injected reset must also hit
+                        # requests that would have been rejected/replayed
+                        chaos_mode = self._inject_chaos(parsed.path)
+                        if chaos_mode == "reset":
+                            self._chaos_reset()
+                            return
+                        if chaos_mode == "error":
+                            raise KubeMLError("chaos: injected server fault",
+                                              500)
+                    # deadline enforcement: reject work nobody is waiting for
+                    deadline = resilience.parse_deadline(
+                        self.headers.get(resilience.DEADLINE_HEADER))
+                    if (deadline is not None and not internal
+                            and deadline <= time.time()):
+                        resilience.incr("kubeml_http_deadline_rejected_total",
+                                        router.name)
+                        raise KubeMLError(
+                            f"deadline expired {parsed.path} "
+                            f"({router.name})", 504)
+                    # idempotency replay: a retried keyed POST answers from
+                    # the recorded response instead of re-executing; a
+                    # duplicate racing the still-running original WAITS for
+                    # it rather than executing the side effect twice
+                    idem_key = self.headers.get(resilience.IDEMPOTENCY_HEADER)
+                    if idem_key and method == "POST":
+                        state, val = router.replay.acquire(
+                            method, parsed.path, idem_key)
+                        if state == "wait":
+                            # the original is mid-flight: wait it out (up to
+                            # the request's own remaining deadline — a slow
+                            # keyed op like quantize legitimately runs for
+                            # minutes), then replay its record — or execute
+                            # ourselves if it abandoned (non-2xx left no
+                            # side effects behind)
+                            wait_s = 30.0
+                            if deadline is not None:
+                                wait_s = min(
+                                    max(deadline - time.time(), 1.0), 600.0)
+                            val.wait(timeout=wait_s)
+                            val = router.replay.get(method, parsed.path,
+                                                    idem_key)
+                            state = "replay" if val is not None else "owner"
+                        if state == "replay":
+                            resilience.incr(
+                                "kubeml_http_idempotent_replays_total",
+                                router.name)
+                            replayed = True
+                            resp = val
+                            raise _Replayed()
+                        replay_owner = True
                     # distributed tracing: bind the inbound W3C context to
                     # this handler thread (downstream hops forward it even
                     # when local recording is off) and record a server span
@@ -172,8 +277,9 @@ class Service:
                     ctx = tracing.parse_traceparent(
                         self.headers.get("traceparent"))
                     tracer = tracing.get_tracer()
-                    with tracing.use_context(ctx):
-                        if parsed.path in ("/health", "/metrics"):
+                    with tracing.use_context(ctx), \
+                            resilience.bind_deadline(deadline):
+                        if internal:
                             resp = router.dispatch(
                                 method, parsed.path, parse_qs(parsed.query),
                                 body, self.headers)
@@ -185,13 +291,35 @@ class Service:
                                 resp = router.dispatch(
                                     method, parsed.path, parse_qs(parsed.query),
                                     body, self.headers)
+                except _Replayed:
+                    pass
                 except KubeMLError as e:
-                    resp = Response(e.to_dict(), status=e.status_code)
+                    headers = {}
+                    retry_after = getattr(e, "retry_after", None)
+                    if retry_after is not None:
+                        headers["Retry-After"] = str(int(retry_after))
+                    resp = Response(e.to_dict(), status=e.status_code,
+                                    headers=headers)
                 except BrokenPipeError:
+                    if replay_owner:  # release any duplicate waiting on us
+                        router.replay.settle(method, urlparse(self.path).path,
+                                             idem_key)
                     return
                 except Exception as e:  # generic 500 envelope (server.py:133-151)
                     log.exception("%s: unhandled error on %s %s", router.name, method, self.path)
                     resp = Response({"error": str(e), "code": 500}, status=500)
+                if replay_owner:
+                    # record SUCCESSES only: replay exists to stop a retried
+                    # delivery from re-running side effects, and only a 2xx
+                    # has them. A 4xx/5xx left no state behind and may be
+                    # transient (momentary 404/409), so re-executing is both
+                    # safe and more accurate than a stale cached verdict;
+                    # streams can't be replayed at all. Settling also wakes
+                    # any duplicate delivery that waited on this execution.
+                    ok = (not isinstance(resp, StreamResponse)
+                          and resp.status < 300)
+                    router.replay.settle(method, urlparse(self.path).path,
+                                         idem_key, resp if ok else None)
                 try:
                     self._respond(resp)
                 except BrokenPipeError:

@@ -1,0 +1,699 @@
+"""Control-plane resilience: retries, circuit breakers, deadlines, chaos.
+
+The reference's tolerance story stops at the merge — a round averages whoever
+responded (reference: ml/pkg/train/util.go:144-166) — while every HTTP hop
+between its services is a one-shot call. Here the transport layer itself is
+hardened, so one reset connection never kills a job the K-AVG math would have
+survived:
+
+* :class:`RetryPolicy` — bounded attempts with exponential backoff + jitter,
+  throttled by a per-destination :class:`RetryBudget` (a token bucket earning
+  a fraction of live traffic: a hard outage degrades to ~budget_ratio extra
+  load instead of an attempts-times retry storm).
+* :class:`CircuitBreaker` — per-destination closed → open → half-open. After
+  ``threshold`` consecutive transport failures the destination is cut off for
+  ``cooldown`` seconds; one half-open probe then decides between closing and
+  re-opening. Fail-fast beats queueing on a dead peer.
+* **Deadlines** — an absolute ``x-kubeml-deadline`` (unix seconds) stamped at
+  the request origin (from the client's own timeout), bound to the handler
+  thread by utils.httpd, and re-propagated by every downstream hop with the
+  read timeout clamped to the remaining budget. Servers reject already-expired
+  requests with 504 instead of doing work nobody is waiting for.
+* **Idempotency keys** — non-idempotent POSTs opt into retries by carrying an
+  ``x-kubeml-idempotency-key``; the server's :class:`ReplayCache` returns the
+  recorded response on redelivery, so a retried train submit can't double-run.
+* **Chaos** — env-gated fault injection at the network layer (the transport
+  complement of engine.failures.FailureInjector's worker masks): the server
+  middleware injects delay/500/connection-reset per route, the client side
+  injects ConnectionErrors before the bytes leave. Off by default; tier-1
+  must never see it.
+
+Everything increments process-local counters rendered into the PS ``/metrics``
+exposition (ps/metrics.MetricsRegistry appends :func:`render_metrics`).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import re
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Tuple
+from urllib.parse import urlsplit
+
+import requests
+
+log = logging.getLogger("kubeml.resilience")
+
+DEADLINE_HEADER = "x-kubeml-deadline"
+IDEMPOTENCY_HEADER = "x-kubeml-idempotency-key"
+
+# statuses worth a retry for a RETRYABLE call (the peer said "not me, not
+# now" — including 500, which chaos and crashed handlers both produce;
+# retryable means idempotent-or-keyed, so re-execution is always safe).
+# 429 is deliberately absent — shed work must stay shed: the 429 surfaces to
+# the CALLER with its Retry-After hint (api.errors.OverloadedError
+# .retry_after, carried in the envelope across hops) so backing off is the
+# caller's decision, never an automatic hammer on an overloaded queue
+RETRY_STATUSES = (500, 502, 503, 504)
+
+
+class CircuitOpenError(requests.ConnectionError):
+    """Raised instead of dialing a destination whose breaker is open. A
+    subclass of ``requests.ConnectionError`` so every existing
+    ``except RequestException`` site treats it as the unreachable peer it
+    stands for."""
+
+
+class DeadlineExpiredError(requests.Timeout):
+    """The request's deadline passed before (or between) send attempts."""
+
+
+# --- counters (rendered on the PS /metrics exposition) ---
+
+_counters_lock = threading.Lock()
+# {(metric, label_value): count}; metric names WITHOUT the kubeml_ prefix
+_counters: Dict[Tuple[str, str], float] = {}
+
+COUNTER_HELP = {
+    "kubeml_http_retries_total": (
+        "dest", "Outbound HTTP retry attempts per destination"),
+    "kubeml_http_retry_budget_exhausted_total": (
+        "dest", "Retries suppressed by the per-destination retry budget"),
+    "kubeml_http_breaker_open_total": (
+        "dest", "Circuit-breaker transitions into the open state"),
+    "kubeml_http_breaker_rejected_total": (
+        "dest", "Requests rejected fast by an open circuit breaker"),
+    "kubeml_http_deadline_rejected_total": (
+        "service", "Requests rejected server-side with an expired deadline"),
+    "kubeml_http_deadline_expired_total": (
+        "dest", "Requests abandoned client-side on an expired deadline"),
+    "kubeml_http_idempotent_replays_total": (
+        "service", "Responses served from the idempotency replay cache"),
+    "kubeml_chaos_injected_total": (
+        "mode", "Injected network faults by mode (delay/error/reset/client)"),
+}
+
+
+# label-cardinality bound per metric: ephemeral destinations (one per
+# standalone runner) must not grow the exposition forever — oldest label
+# evicts, mirroring the 32-job histogram bound in ps/metrics.py
+MAX_LABELS_PER_METRIC = 256
+
+
+def incr(metric: str, label_value: str = "", n: float = 1.0) -> None:
+    with _counters_lock:
+        key = (metric, label_value)
+        if key not in _counters:
+            labels = [k for k in _counters if k[0] == metric]
+            if len(labels) >= MAX_LABELS_PER_METRIC:
+                del _counters[labels[0]]  # dict order: oldest first
+        _counters[key] = _counters.get(key, 0.0) + n
+
+
+def counter_value(metric: str, label_value: str = "") -> float:
+    with _counters_lock:
+        return _counters.get((metric, label_value), 0.0)
+
+
+def counters_snapshot() -> Dict[Tuple[str, str], float]:
+    with _counters_lock:
+        return dict(_counters)
+
+
+def render_metrics() -> List[str]:
+    """Prometheus exposition lines for the resilience counters plus the live
+    per-destination breaker-state gauge (0 closed, 1 half-open, 2 open)."""
+    snap = counters_snapshot()
+    lines: List[str] = []
+    for metric, (label, help_text) in COUNTER_HELP.items():
+        lines.append(f"# HELP {metric} {help_text}")
+        lines.append(f"# TYPE {metric} counter")
+        for (m, value_label), v in sorted(snap.items()):
+            if m == metric:
+                lines.append(f'{metric}{{{label}="{value_label}"}} {v:g}')
+    lines.append("# HELP kubeml_http_breaker_state Circuit-breaker state per "
+                 "destination (0=closed, 1=half-open, 2=open)")
+    lines.append("# TYPE kubeml_http_breaker_state gauge")
+    with _registry_lock:
+        breakers = sorted(_breakers.items())
+    for dest, br in breakers:
+        lines.append(f'kubeml_http_breaker_state{{dest="{dest}"}} '
+                     f'{br.state_value}')
+    return lines
+
+
+# --- retry policy + budget ---
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded-retry schedule: ``attempts`` total tries, exponential backoff
+    from ``backoff`` doubling up to ``backoff_max``, each delay jittered
+    uniformly in [0.5, 1.0]x (full-jitter halves synchronized thundering
+    herds after a shared blip)."""
+
+    attempts: int = 3
+    backoff: float = 0.1
+    backoff_max: float = 2.0
+    budget_ratio: float = 0.2
+
+    @classmethod
+    def from_config(cls) -> "RetryPolicy":
+        from ..api.config import get_config
+
+        cfg = get_config()
+        return cls(attempts=cfg.retry_attempts, backoff=cfg.retry_backoff,
+                   backoff_max=cfg.retry_backoff_max,
+                   budget_ratio=cfg.retry_budget)
+
+    def delay(self, attempt: int, rng: Optional[random.Random] = None) -> float:
+        base = min(self.backoff * (2 ** attempt), self.backoff_max)
+        r = (rng or random).uniform(0.5, 1.0)
+        return base * r
+
+
+class RetryBudget:
+    """Token bucket bounding retries to a fraction of live traffic: every
+    first attempt deposits ``ratio`` tokens (capped), every retry withdraws
+    one. Under a sustained outage the retry load converges to ~ratio of the
+    request rate instead of multiplying it by the attempt count."""
+
+    def __init__(self, ratio: float = 0.2, cap: float = 20.0,
+                 initial: float = 5.0):
+        self.ratio = float(ratio)
+        self.cap = float(cap)
+        self._tokens = min(float(initial), self.cap)
+        self._lock = threading.Lock()
+
+    def deposit(self) -> None:
+        with self._lock:
+            self._tokens = min(self._tokens + self.ratio, self.cap)
+
+    def withdraw(self) -> bool:
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+
+# --- circuit breaker ---
+
+STATE_CLOSED = "closed"
+STATE_OPEN = "open"
+STATE_HALF_OPEN = "half-open"
+
+_STATE_VALUES = {STATE_CLOSED: 0, STATE_HALF_OPEN: 1, STATE_OPEN: 2}
+
+
+class CircuitBreaker:
+    """Per-destination closed → open → half-open state machine.
+
+    ``threshold`` CONSECUTIVE transport failures open the circuit; while open,
+    :meth:`allow` rejects instantly until ``cooldown`` seconds pass, then
+    exactly one probe is let through (half-open). The probe's success closes
+    the circuit; its failure re-opens it for another cooldown."""
+
+    def __init__(self, threshold: int = 5, cooldown: float = 5.0,
+                 dest: str = ""):
+        self.threshold = max(1, int(threshold))
+        self.cooldown = float(cooldown)
+        self.dest = dest
+        self._lock = threading.Lock()
+        self._state = STATE_CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def state_value(self) -> int:
+        return _STATE_VALUES[self.state]
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self._state == STATE_CLOSED:
+                return True
+            if self._state == STATE_OPEN:
+                if time.monotonic() - self._opened_at < self.cooldown:
+                    return False
+                self._state = STATE_HALF_OPEN
+                self._probe_in_flight = True
+                return True
+            # half-open: one probe at a time decides the circuit's fate
+            if self._probe_in_flight:
+                return False
+            self._probe_in_flight = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self._state != STATE_CLOSED:
+                log.info("circuit for %s closed (probe succeeded)", self.dest)
+            self._state = STATE_CLOSED
+            self._consecutive_failures = 0
+            self._probe_in_flight = False
+
+    def record_failure(self) -> None:
+        opened = False
+        with self._lock:
+            self._consecutive_failures += 1
+            self._probe_in_flight = False
+            if self._state == STATE_HALF_OPEN:
+                self._state = STATE_OPEN
+                self._opened_at = time.monotonic()
+                opened = True
+            elif (self._state == STATE_CLOSED
+                  and self._consecutive_failures >= self.threshold):
+                self._state = STATE_OPEN
+                self._opened_at = time.monotonic()
+                opened = True
+        if opened:
+            incr("kubeml_http_breaker_open_total", self.dest)
+            log.warning("circuit for %s opened after %d consecutive "
+                        "failure(s); cooling down %.1fs", self.dest,
+                        self._consecutive_failures, self.cooldown)
+
+
+_registry_lock = threading.Lock()
+_breakers: Dict[str, CircuitBreaker] = {}
+_budgets: Dict[str, RetryBudget] = {}
+
+# registry bound: every standalone runner is a fresh ephemeral host:port —
+# a long-lived PS must not accumulate dead runners' breakers/budgets forever
+MAX_DESTINATIONS = 128
+
+
+def destination(url: str) -> str:
+    """The breaker/budget key of a URL: its ``host:port`` authority."""
+    return urlsplit(url).netloc or url
+
+
+def _bound_registry(registry: Dict[str, object]) -> None:
+    while len(registry) >= MAX_DESTINATIONS:  # caller holds _registry_lock
+        registry.pop(next(iter(registry)))  # dict order: oldest first
+
+
+def get_breaker(dest: str) -> CircuitBreaker:
+    from ..api.config import get_config
+
+    with _registry_lock:
+        br = _breakers.get(dest)
+        if br is None:
+            cfg = get_config()
+            _bound_registry(_breakers)
+            br = _breakers[dest] = CircuitBreaker(
+                threshold=cfg.breaker_threshold,
+                cooldown=cfg.breaker_cooldown, dest=dest)
+        return br
+
+
+def get_budget(dest: str) -> RetryBudget:
+    from ..api.config import get_config
+
+    with _registry_lock:
+        b = _budgets.get(dest)
+        if b is None:
+            _bound_registry(_budgets)
+            b = _budgets[dest] = RetryBudget(ratio=get_config().retry_budget)
+        return b
+
+
+def reset_state() -> None:
+    """Drop every breaker/budget/counter (test isolation; a fresh process
+    starts clean anyway)."""
+    with _registry_lock:
+        _breakers.clear()
+        _budgets.clear()
+    with _counters_lock:
+        _counters.clear()
+
+
+# --- deadline propagation ---
+
+_tls = threading.local()
+
+
+def _deadline_stack() -> list:
+    s = getattr(_tls, "deadlines", None)
+    if s is None:
+        s = _tls.deadlines = []
+    return s
+
+
+def current_deadline() -> Optional[float]:
+    """The absolute deadline (unix seconds) bound to this thread, or None."""
+    s = _deadline_stack()
+    return s[-1] if s else None
+
+
+@contextmanager
+def bind_deadline(deadline: Optional[float]) -> Iterator[None]:
+    """Bind an absolute deadline to this thread (httpd binds the inbound
+    header; worker threads re-bind a submitter's). None is a no-op."""
+    if deadline is None:
+        yield
+        return
+    s = _deadline_stack()
+    s.append(float(deadline))
+    try:
+        yield
+    finally:
+        s.pop()
+
+
+def parse_deadline(header: Optional[str]) -> Optional[float]:
+    """Decode an ``x-kubeml-deadline`` header; None on absent/garbage input
+    (a malformed peer header must never fail the request it rode in on)."""
+    if not header:
+        return None
+    try:
+        v = float(header)
+    except (TypeError, ValueError):
+        return None
+    return v if v > 0 else None
+
+
+def format_deadline(deadline: float) -> str:
+    return f"{deadline:.6f}"
+
+
+def deadline_from_timeout(timeout) -> Optional[float]:
+    """Derive an origin deadline from a requests-style timeout (float or
+    (connect, read) tuple): now + read timeout."""
+    read = read_timeout(timeout)
+    return time.time() + read if read is not None else None
+
+
+def read_timeout(timeout) -> Optional[float]:
+    if timeout is None:
+        return None
+    if isinstance(timeout, (tuple, list)):
+        return timeout[1] if len(timeout) > 1 and timeout[1] else None
+    return float(timeout)
+
+
+def clamp_timeout(timeout, remaining: float):
+    """Cap a requests timeout's READ component to the remaining deadline
+    budget (connect stays put — a connect must never eat the whole budget)."""
+    remaining = max(remaining, 0.001)
+    if timeout is None:
+        return remaining
+    if isinstance(timeout, (tuple, list)):
+        connect = timeout[0]
+        read = timeout[1] if len(timeout) > 1 else None
+        read = remaining if read is None else min(float(read), remaining)
+        return (connect, read)
+    return min(float(timeout), remaining)
+
+
+# --- chaos (network-level fault injection) ---
+
+# route exclusions even when a chaos regex matches everything: liveness polls
+# and the metrics scrape must stay observable while chaos rages
+CHAOS_EXEMPT_PATHS = ("/health", "/metrics")
+
+_CHAOS_ENV_KEYS = ("KUBEML_CHAOS", "KUBEML_CHAOS_CLIENT", "KUBEML_CHAOS_ROUTES",
+                   "KUBEML_CHAOS_MODES", "KUBEML_CHAOS_DELAY",
+                   "KUBEML_CHAOS_SEED")
+
+
+class ChaosConfig:
+    """Parsed chaos knobs (all env-gated, all off by default):
+
+    ``KUBEML_CHAOS``         server-side fault probability per request (0..1)
+    ``KUBEML_CHAOS_CLIENT``  client-side ConnectionError probability (0..1)
+    ``KUBEML_CHAOS_ROUTES``  regex a request path must match (default: all)
+    ``KUBEML_CHAOS_MODES``   comma list of delay,error,reset (default: all)
+    ``KUBEML_CHAOS_DELAY``   max injected delay seconds (default 0.2)
+    ``KUBEML_CHAOS_SEED``    deterministic RNG seed (default: entropy)
+    """
+
+    def __init__(self, server_p: float = 0.0, client_p: float = 0.0,
+                 routes: str = "", modes: str = "", max_delay: float = 0.2,
+                 seed: Optional[int] = None):
+        self.server_p = min(max(server_p, 0.0), 1.0)
+        self.client_p = min(max(client_p, 0.0), 1.0)
+        self.routes = re.compile(routes) if routes else None
+        valid = ("delay", "error", "reset")
+        self.modes = tuple(m.strip() for m in modes.split(",")
+                           if m.strip() in valid) or valid
+        self.max_delay = max(0.0, max_delay)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls) -> "ChaosConfig":
+        def f(name, default="0"):
+            try:
+                return float(os.environ.get(name) or default)
+            except ValueError:
+                return float(default)
+
+        seed_s = os.environ.get("KUBEML_CHAOS_SEED", "")
+        return cls(
+            server_p=f("KUBEML_CHAOS"),
+            client_p=f("KUBEML_CHAOS_CLIENT"),
+            routes=os.environ.get("KUBEML_CHAOS_ROUTES", ""),
+            modes=os.environ.get("KUBEML_CHAOS_MODES", ""),
+            max_delay=f("KUBEML_CHAOS_DELAY", "0.2"),
+            seed=int(seed_s) if seed_s else None,
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.server_p > 0.0 or self.client_p > 0.0
+
+    def _roll(self) -> float:
+        with self._lock:
+            return self._rng.random()
+
+    def _choice(self, seq):
+        with self._lock:
+            return self._rng.choice(seq)
+
+    def server_fault(self, path: str) -> Optional[Tuple[str, float]]:
+        """(mode, delay_s) to inject for this request, or None. ``delay_s``
+        is meaningful for mode "delay" only."""
+        if self.server_p <= 0.0 or path in CHAOS_EXEMPT_PATHS:
+            return None
+        if self.routes is not None and not self.routes.search(path):
+            return None
+        if self._roll() >= self.server_p:
+            return None
+        mode = self._choice(self.modes)
+        delay = self._roll() * self.max_delay if mode == "delay" else 0.0
+        incr("kubeml_chaos_injected_total", mode)
+        return (mode, delay)
+
+    def client_fault(self, url: str) -> bool:
+        """Whether to fail this outbound request before it leaves."""
+        if self.client_p <= 0.0:
+            return False
+        path = urlsplit(url).path or "/"
+        if path in CHAOS_EXEMPT_PATHS:
+            return False
+        if self.routes is not None and not self.routes.search(path):
+            return False
+        if self._roll() >= self.client_p:
+            return False
+        incr("kubeml_chaos_injected_total", "client")
+        return True
+
+
+_chaos_cache: Tuple[Optional[tuple], Optional[ChaosConfig]] = (None, None)
+_chaos_lock = threading.Lock()
+
+
+def chaos() -> ChaosConfig:
+    """The process chaos config, rebuilt when the env fingerprint changes
+    (tests toggle the env vars at runtime)."""
+    global _chaos_cache
+    fingerprint = tuple(os.environ.get(k) for k in _CHAOS_ENV_KEYS)
+    with _chaos_lock:
+        cached_fp, cached = _chaos_cache
+        if cached is None or cached_fp != fingerprint:
+            cached = ChaosConfig.from_env()
+            _chaos_cache = (fingerprint, cached)
+        return cached
+
+
+# --- idempotency replay cache (server side) ---
+
+
+class ReplayCache:
+    """Bounded TTL cache of (method, path, idempotency-key) → recorded
+    response, so a retried non-idempotent request is answered from the record
+    instead of re-executed (the PS's raced-runner dedup, made explicit).
+
+    Also tracks IN-FLIGHT executions: a duplicate arriving while the
+    original is still running gets a wait event (:meth:`acquire` →
+    ``("wait", event)``) instead of racing into a second execution — the
+    classic replay-cache hole where a timeout-triggered retry lands before
+    the slow original records its response. The wait is bounded (the
+    duplicate's own deadline, utils.httpd): a duplicate that outwaits an
+    extremely slow original falls back to executing — best-effort dedup,
+    not a distributed transaction."""
+
+    def __init__(self, max_entries: int = 256, ttl: float = 300.0):
+        self.max_entries = int(max_entries)
+        self.ttl = float(ttl)
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple[str, str, str], Tuple[float, object]] = {}
+        self._pending: Dict[Tuple[str, str, str], threading.Event] = {}
+
+    def get(self, method: str, path: str, key: str):
+        now = time.monotonic()
+        with self._lock:
+            rec = self._entries.get((method, path, key))
+            if rec is None:
+                return None
+            stored_at, resp = rec
+            if now - stored_at > self.ttl:
+                del self._entries[(method, path, key)]
+                return None
+            return resp
+
+    def put(self, method: str, path: str, key: str, resp) -> None:
+        with self._lock:
+            self._entries[(method, path, key)] = (time.monotonic(), resp)
+            while len(self._entries) > self.max_entries:
+                self._entries.pop(next(iter(self._entries)))
+
+    def acquire(self, method: str, path: str, key: str):
+        """Claim a keyed execution: ``("replay", resp)`` when a record
+        exists, ``("wait", event)`` when the original is mid-flight (wait,
+        then re-check :meth:`get`), else ``("owner", None)`` — the caller
+        executes and MUST :meth:`settle` afterwards."""
+        k = (method, path, key)
+        resp = self.get(method, path, key)
+        if resp is not None:
+            return ("replay", resp)
+        with self._lock:
+            ev = self._pending.get(k)
+            if ev is not None:
+                return ("wait", ev)
+            self._pending[k] = threading.Event()
+            return ("owner", None)
+
+    def settle(self, method: str, path: str, key: str, resp=None) -> None:
+        """Owner finished: record ``resp`` (None = abandon, e.g. a non-2xx
+        that should re-execute on retry) and release any waiters."""
+        k = (method, path, key)
+        if resp is not None:
+            self.put(method, path, key, resp)
+        with self._lock:
+            ev = self._pending.pop(k, None)
+        if ev is not None:
+            ev.set()
+
+
+# --- the resilient request loop (traced_http's engine) ---
+
+IDEMPOTENT_METHODS = ("GET", "HEAD", "PUT", "DELETE")
+
+
+def resilient_request(method: str, url: str, *, retryable: bool,
+                      deadline: Optional[float] = None,
+                      stamp_origin: bool = False,
+                      use_breaker: bool = True,
+                      policy: Optional[RetryPolicy] = None,
+                      **kwargs) -> requests.Response:
+    """One outbound HTTP call under the full policy stack: circuit breaker
+    gate, client-side chaos, bounded budget-throttled retries (only when
+    ``retryable`` — idempotent method or idempotency-keyed), and deadline
+    clamping. A BOUND ``deadline`` is the chain's total budget and gates the
+    loop; with ``stamp_origin`` (no bound deadline) each attempt stamps a
+    fresh per-attempt deadline header instead, so servers still reject stale
+    work but a read timeout doesn't swallow the whole retry schedule. Raises
+    the transport error (or returns the last retryable-status response) once
+    attempts/budget/deadline run out."""
+    dest = destination(url)
+    policy = policy or RetryPolicy.from_config()
+    budget = get_budget(dest)
+    budget.deposit()
+    breaker = get_breaker(dest)
+    attempts = max(1, policy.attempts) if retryable else 1
+    base_timeout = kwargs.pop("timeout", None)
+    last_exc: Optional[Exception] = None
+    last_resp: Optional[requests.Response] = None
+    for attempt in range(attempts):
+        timeout = base_timeout
+        if deadline is not None:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                incr("kubeml_http_deadline_expired_total", dest)
+                if last_exc is not None:
+                    raise last_exc
+                if last_resp is not None:
+                    return last_resp
+                raise DeadlineExpiredError(
+                    f"deadline expired before {method} {url}")
+            timeout = clamp_timeout(base_timeout, remaining)
+        elif stamp_origin:
+            rt = read_timeout(base_timeout)
+            if rt is not None:
+                headers = kwargs.setdefault("headers", {})
+                headers[DEADLINE_HEADER] = format_deadline(time.time() + rt)
+        if use_breaker and not breaker.allow():
+            incr("kubeml_http_breaker_rejected_total", dest)
+            raise CircuitOpenError(
+                f"circuit open for {dest} (failing {method} {url} fast)")
+        if attempt:
+            incr("kubeml_http_retries_total", dest)
+        try:
+            if chaos().client_fault(url):
+                raise requests.ConnectionError(
+                    f"chaos: injected client-side connection error to {dest}")
+            resp = requests.request(method, url, timeout=timeout, **kwargs)
+        except (requests.ConnectionError, requests.Timeout) as e:
+            if use_breaker:
+                breaker.record_failure()
+            last_exc, last_resp = e, None
+        except Exception:
+            # anything else (mid-body drop → ChunkedEncodingError, bad args,
+            # ...) must still settle the breaker: a half-open probe that
+            # neither succeeds nor fails would leave _probe_in_flight set and
+            # wedge the destination forever
+            if use_breaker:
+                breaker.record_failure()
+            raise
+        else:
+            # breaker scope: TRANSPORT failures only. Any response at all —
+            # even a 5xx — proves the destination is reachable; in this
+            # codebase 500 is an application error and 503 is an application
+            # state ("job still starting"), and either would otherwise let
+            # one busy/broken route blackhole every other route on the
+            # destination. Retryable statuses still retry below.
+            breaker.record_success()
+            if resp.status_code not in RETRY_STATUSES:
+                return resp
+            last_exc, last_resp = None, resp
+        if attempt + 1 >= attempts:
+            break
+        if not budget.withdraw():
+            incr("kubeml_http_retry_budget_exhausted_total", dest)
+            break
+        delay = policy.delay(attempt)
+        if deadline is not None:
+            delay = min(delay, max(deadline - time.time(), 0.0))
+        if delay > 0:
+            time.sleep(delay)
+    if last_exc is not None:
+        raise last_exc
+    assert last_resp is not None
+    return last_resp

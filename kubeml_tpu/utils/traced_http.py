@@ -1,18 +1,33 @@
-"""Outbound HTTP with W3C trace propagation.
+"""Outbound HTTP with W3C trace propagation + the resilience policy stack.
 
 Drop-in for the ``requests`` surface the control-plane clients use
 (``get/post/put/delete`` plus the exception/response types re-exported), with
-one addition: every request is stamped with the calling thread's current
-trace context as a ``traceparent`` header (utils.tracing.trace_headers), so
-every internal hop — controller → scheduler → PS → job runner → storage —
-carries the trace across the process boundary. Caller-supplied headers win
-on conflict.
+three additions applied to every internal hop — controller → scheduler → PS →
+job runner → storage:
+
+* **tracing** — the calling thread's current trace context rides as a
+  ``traceparent`` header (utils.tracing.trace_headers); caller headers win.
+* **resilience** (utils.resilience) — per-destination circuit breaker,
+  bounded budget-throttled retries for idempotent calls (GET/PUT/DELETE and
+  any call passing ``idempotency_key=``, which rides as
+  ``x-kubeml-idempotency-key`` so the server's replay cache dedups a retried
+  delivery), and client-side chaos injection when enabled.
+* **deadlines** — the thread's bound deadline (or, at the origin, ``now +
+  read timeout``) is stamped as ``x-kubeml-deadline`` and the read timeout is
+  clamped to the remaining budget, so a request chain can never outlive the
+  caller that asked for it.
+
+``retryable=True``/``False`` overrides the per-method default (e.g. POST
+/infer is computationally pure and safe to retry without a key).
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
 import requests
 
+from . import resilience
 from .tracing import trace_headers
 
 # re-exported so call sites can treat this module as their `requests`
@@ -20,11 +35,60 @@ RequestException = requests.RequestException
 ConnectionError = requests.ConnectionError
 Timeout = requests.Timeout
 Response = requests.Response
+CircuitOpenError = resilience.CircuitOpenError
+DeadlineExpiredError = resilience.DeadlineExpiredError
+
+# sane connect-phase default: no hop may burn its whole read budget failing
+# to even reach the peer (the satellite audit's (connect, read) discipline)
+DEFAULT_CONNECT_TIMEOUT = 3.05
 
 
-def request(method: str, url: str, **kwargs) -> requests.Response:
-    kwargs["headers"] = trace_headers(kwargs.get("headers"))
-    return requests.request(method, url, **kwargs)
+def timeouts(read: float, connect: Optional[float] = None) -> tuple:
+    """An explicit ``(connect, read)`` timeout tuple for a call site that
+    previously passed a bare read timeout. The connect default comes from
+    ``KUBEML_CONNECT_TIMEOUT`` (api.config)."""
+    if connect is None:
+        try:
+            from ..api.config import get_config
+
+            connect = get_config().http_connect_timeout
+        except Exception:
+            connect = DEFAULT_CONNECT_TIMEOUT
+    return (connect, read)
+
+
+def request(method: str, url: str, *, retryable: Optional[bool] = None,
+            idempotency_key=None, use_breaker: bool = True,
+            **kwargs) -> requests.Response:
+    headers = trace_headers(kwargs.pop("headers", None))
+    if idempotency_key is True:
+        # auto-mint: one fresh key per logical call — the common case; pass
+        # a string to share one key across a caller's own retry loop
+        import uuid
+
+        idempotency_key = uuid.uuid4().hex
+    if idempotency_key:
+        headers.setdefault(resilience.IDEMPOTENCY_HEADER, idempotency_key)
+    # deadline semantics: a BOUND deadline (propagated from an inbound
+    # request) is the chain's total budget — it gates and clamps retries.
+    # At the ORIGIN there is no chain budget: each attempt stamps a fresh
+    # "now + read timeout" header (resilient_request does it per attempt) so
+    # the server can reject stale work, but a read-timeout failure does NOT
+    # consume the retry schedule — otherwise timeouts, the most common
+    # transient, would never be retried at all.
+    deadline = resilience.current_deadline()
+    stamp_origin = (deadline is None
+                    and resilience.DEADLINE_HEADER not in headers)
+    if deadline is not None:
+        headers.setdefault(resilience.DEADLINE_HEADER,
+                           resilience.format_deadline(deadline))
+    kwargs["headers"] = headers
+    if retryable is None:
+        retryable = (method.upper() in resilience.IDEMPOTENT_METHODS
+                     or idempotency_key is not None)
+    return resilience.resilient_request(
+        method, url, retryable=retryable, deadline=deadline,
+        stamp_origin=stamp_origin, use_breaker=use_breaker, **kwargs)
 
 
 def get(url: str, **kwargs) -> requests.Response:
