@@ -16,7 +16,35 @@ against a LeNet figure, never a ResNet one.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Tuple
+from typing import Dict, Optional, Tuple
+
+# the bench regression gate's metric vocabulary (scripts/bench_compare.py):
+# normalized key -> (source field in the bench JSON line, direction)
+GATE_METRICS = {
+    "device_samples_per_sec": ("value", "higher"),
+    "end_to_end_samples_per_sec": ("end_to_end", "higher"),
+    "mfu": ("mfu", "higher"),
+}
+
+
+def normalize_bench_row(doc: dict) -> Dict[str, Optional[float]]:
+    """One normalized metric row from a bench record — either the driver's
+    raw one-JSON-line output of ``bench.py`` or the ``BENCH_r0N.json``
+    wrapper holding it under ``parsed``. Missing/unreported metrics come
+    back None (the regression gate skips them rather than failing on an
+    unknown-hardware MFU); an error row keeps its ``error`` so the gate can
+    fail a broken candidate outright."""
+    row = doc.get("parsed") if isinstance(doc.get("parsed"), dict) else doc
+    out: Dict[str, Optional[float]] = {"metric": row.get("metric")}
+    for key, (field_name, _direction) in GATE_METRICS.items():
+        v = row.get(field_name)
+        try:
+            out[key] = float(v) if v is not None else None
+        except (TypeError, ValueError):
+            out[key] = None
+    if row.get("error"):
+        out["error"] = str(row["error"])
+    return out
 
 
 @dataclass(frozen=True)

@@ -419,6 +419,40 @@ def cmd_trace(args) -> int:
     return 0
 
 
+# --- profile: per-phase byte/FLOP attribution of a task ---
+
+
+def cmd_profile(args) -> int:
+    """``kubeml profile <task-id> [-o out.json]``: fold the task's merged
+    span tree (with the byte/FLOP attributes the data-plane seams record)
+    into a per-phase attribution report — wall seconds, bytes, FLOPs,
+    achieved bandwidth, and a compute-bound vs transfer-bound verdict per
+    phase — plus each process's data-plane counter budget. ``-o`` writes the
+    Perfetto trace WITH counter tracks (cumulative data-plane bytes,
+    per-transfer bandwidth) next to the report."""
+    from .utils.profiler import attribution_report, perfetto_with_counters
+
+    data = _client(args).tasks().trace(args.id)
+    spans = data.get("spans", [])
+    report = attribution_report(spans, counters=data.get("counters"))
+    report["task_id"] = args.id
+    report["trace_ids"] = data.get("trace_ids")
+    if args.out:
+        from pathlib import Path
+
+        out = Path(args.out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(perfetto_with_counters(spans)))
+        report["perfetto_trace"] = str(out)
+        print(f"{out}: Perfetto trace with counter tracks "
+              f"({len(spans)} spans)", file=sys.stderr)
+    _print(report)
+    if data.get("dropped"):
+        print(f"warning: {data['dropped']} spans dropped at the collector "
+              f"cap — byte totals are a floor", file=sys.stderr)
+    return 0
+
+
 # --- start: boot the all-in-one cluster ---
 
 
@@ -626,6 +660,14 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--out", "-o", default=None,
                     help="write the Chrome trace here (default: stdout)")
     tr.set_defaults(fn=cmd_trace)
+
+    pr = sub.add_parser("profile",
+                        help="per-phase byte/FLOP attribution report of a "
+                             "task (+ Perfetto trace with counter tracks)")
+    pr.add_argument("id", help="task/job id")
+    pr.add_argument("--out", "-o", default=None,
+                    help="write the Perfetto counter-track trace here")
+    pr.set_defaults(fn=cmd_profile)
 
     lg = sub.add_parser("logs", help="show cluster logs")
     lg.add_argument("--id", default=None, help="filter by job id")

@@ -188,8 +188,12 @@ class _Tasks:
 
     def trace(self, job_id: str) -> dict:
         """The merged distributed trace of a (completed) task:
-        ``{"task_id", "trace_ids", "spans": [span dicts]}`` — render with
-        ``kubeml_tpu.utils.tracing.merge_chrome_trace``."""
+        ``{"task_id", "trace_ids", "spans": [span dicts], "counters":
+        {service: data-plane snapshot}}`` — render the spans with
+        ``kubeml_tpu.utils.tracing.merge_chrome_trace``, or fold spans +
+        counters into the per-phase byte/FLOP attribution with
+        ``kubeml_tpu.utils.profiler.attribution_report`` (the
+        ``kubeml profile`` report)."""
         return _check(
             requests.get(f"{self.c.url}/tasks/{job_id}/trace", timeout=requests.timeouts(self.c.timeout))
         )

@@ -441,9 +441,14 @@ class TrainJob:
                                 self.job_id, rb.round_index)
                     continue
             t_round = time.time()
+            # byte attribution: the slab this round staged host->HBM rides
+            # the span so `kubeml profile` can classify rounds
+            # compute-bound vs transfer-bound (utils.profiler)
+            slab_bytes = int(sum(getattr(a, "nbytes", 0)
+                                 for a in (rb.x, rb.y, rb.mask)))
             with self.tracer.span("job.round", service="worker",
                                   job=self.job_id, epoch=epoch,
-                                  round=rb.round_index):
+                                  round=rb.round_index, bytes=slab_bytes):
                 loss = self._run_round(rb, rng, worker_mask, epoch, staged=rb_staged)
             if loss is None:  # stop requested during retry backoff
                 break
@@ -486,6 +491,8 @@ class TrainJob:
             # blocking fetch is where the host waits on it, so its wall time
             # is the observable merge cost (kubeml_job_merge_seconds)
             self._last_merge_s = time.time() - t_merge
+            self.tracer.record("job.merge", self._last_merge_s,
+                               service="worker", job=self.job_id, epoch=epoch)
             return mean_loss
         except KubeMLError:
             raise

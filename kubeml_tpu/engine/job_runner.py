@@ -118,10 +118,17 @@ class JobRunner:
 
     def _publish_weights(self, variables: dict, epoch: int) -> None:
         from ..native.weights import publish_variables
+        from ..utils import tracing
 
         store = self._tensor_store
         if store is not None:  # racing shutdown: silently skip
-            publish_variables(store, variables, epoch + 1)
+            # spanned so the per-epoch weight publication shows up in the
+            # task's span tree (publish_variables itself accounts the bytes
+            # and bandwidth — utils.profiler)
+            with tracing.get_tracer().span("runner.publish_weights",
+                                           service="worker",
+                                           job=self.job_id, epoch=epoch):
+                publish_variables(store, variables, epoch + 1)
 
     # --- routes ---
 

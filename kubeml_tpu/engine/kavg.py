@@ -195,6 +195,14 @@ class KAvgTrainer:
             from ..native import f32_to_bf16
 
             x = f32_to_bf16(x)
+        # data-plane accounting: the host->HBM slab bytes this round stages
+        # (dispatch is async, so no blocking duration — the transfer cost
+        # lands on the round's device wall time; the BYTES are what the
+        # staging-share attribution needs)
+        from ..utils import profiler
+
+        profiler.account("stage_round", sum(
+            getattr(a, "nbytes", 0) for a in (x, batch_y, mask)))
         if self.dist is not None:
             def globalize(local):
                 local = np.asarray(local)

@@ -39,15 +39,26 @@ def publish_variables(store, variables: dict, version: int) -> None:
 
     ``version`` must be >= 1 (the seqlock negates it as the in-progress
     sentinel, and readers treat <= 0 as not-ready)."""
+    import time
+
     if version < 1:
         raise ValueError(f"version must be >= 1, got {version}")
     pairs = _flatten(variables)
+    t0 = time.perf_counter()
     store.set(VERSION_KEY, np.array([-version], np.int64))  # in progress
+    nbytes = 0
     for key, arr in pairs:
         store.set(key, arr)
+        nbytes += getattr(arr, "nbytes", 0)
     manifest = json.dumps([k for k, _ in pairs]).encode()
     store.set(MANIFEST_KEY, np.frombuffer(manifest, np.uint8))
     store.set(VERSION_KEY, np.array([version], np.int64))
+    # data-plane accounting: per-round/epoch weight bytes through the
+    # RedisAI-role channel + achieved publish bandwidth (utils.profiler)
+    from ..utils import profiler
+
+    profiler.record_io("weights.publish", nbytes,
+                       time.perf_counter() - t0, version=version)
 
 
 def read_version(reader) -> Optional[int]:
@@ -63,7 +74,10 @@ def fetch_variables(reader, retries: int = 2) -> Tuple[Optional[dict], Optional[
     """Read the full tree; returns (variables, version) or (None, None) when
     nothing is published. Retries when a concurrent publish tears the read
     (detected by the seqlock version flipping through its sentinel)."""
+    import time
+
     for _ in range(retries + 1):
+        t0 = time.perf_counter()
         v0 = read_version(reader)
         if v0 is None:
             return None, None
@@ -81,5 +95,11 @@ def fetch_variables(reader, retries: int = 2) -> Tuple[Optional[dict], Optional[
             leaves[key] = arr
         if torn or read_version(reader) != v0:
             continue  # publish raced us; retry
+        from ..utils import profiler
+
+        profiler.record_io(
+            "weights.fetch",
+            sum(getattr(a, "nbytes", 0) for a in leaves.values()),
+            time.perf_counter() - t0, version=v0)
         return _unflatten(leaves), v0
     return None, None

@@ -23,6 +23,22 @@ from typing import Dict, List, Sequence, Tuple
 
 from ..api.types import MetricUpdate
 
+
+def escape_label_value(v) -> str:
+    """Escape a label VALUE per the Prometheus text exposition format
+    (backslash, double-quote, and newline must be escaped inside the
+    ``label="..."`` quoting — a jobid carrying any of them previously
+    produced an unparseable scrape)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def escape_help(v) -> str:
+    """Escape a HELP string per the exposition format (backslash and
+    newline; quotes are legal in HELP text)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 GAUGES = {
     "kubeml_job_validation_loss": "Validation loss of a train job",
     "kubeml_job_validation_accuracy": "Validation accuracy of a train job",
@@ -88,7 +104,7 @@ class Histogram:
     @staticmethod
     def render_snapshot(name: str, snap: dict, label: str = "",
                         value: str = "") -> List[str]:
-        sel = f'{label}="{value}",' if label else ""
+        sel = f'{label}="{escape_label_value(value)}",' if label else ""
         bare = f'{{{sel[:-1]}}}' if label else ""
         lines = [
             f'{name}_bucket{{{sel}le="{Histogram._fmt_le(float(edge))}"}} {int(c)}'
@@ -129,6 +145,14 @@ SERVING_COUNTERS = {
         "admission_waves", "Batched prefill+admit programs dispatched"),
     "kubeml_serving_chunks_total": ("chunks",
                                     "Decode chunk programs dispatched"),
+    # fetcher pool (results/SERVING_R5_NOTE.md: short-request workloads are
+    # fetch-pipeline-bound on tunneled hosts — the pool must be observable)
+    "kubeml_serving_fetches_total": (
+        "fetches", "Device result fetches completed by the fetcher pool"),
+    "kubeml_serving_fetch_busy_seconds_total": (
+        "fetch_busy_seconds",
+        "Cumulative wall seconds fetcher threads spent blocked on device "
+        "result fetches (rate() / pool size = utilization)"),
 }
 # per-job latency histograms (no reference counterpart — the gauges above
 # keep only the LAST epoch's value). Fed from MetricUpdate; series OUTLIVE
@@ -184,6 +208,16 @@ SERVING_GAUGES = {
         "first_token_p99_seconds", "p99 time to first token"),
     "kubeml_serving_first_token_max_seconds": (
         "first_token_max_seconds", "Max time to first token (recent window)"),
+    "kubeml_serving_fetchers_inflight": (
+        "fetchers_inflight", "Fetcher threads currently blocked on a device "
+                             "result fetch"),
+    # deliberately NOT *_total: the _total suffix is the counter convention,
+    # and this is a gauge one typo away from kubeml_serving_fetches_total
+    "kubeml_serving_fetcher_pool_size": (
+        "fetchers_total", "Configured result-fetcher pool size"),
+    "kubeml_serving_fetcher_utilization": (
+        "fetcher_utilization", "Busy fraction of the fetcher pool (in-flight "
+                               "/ pool size at scrape time)"),
 }
 
 
@@ -263,12 +297,13 @@ class MetricsRegistry:
             lines = []
             for metric, help_text in GAUGES.items():
                 series = [(jid, v) for (m, jid), v in self._values.items() if m == metric]
-                lines.append(f"# HELP {metric} {help_text}")
+                lines.append(f"# HELP {metric} {escape_help(help_text)}")
                 lines.append(f"# TYPE {metric} gauge")
                 for jid, v in sorted(series):
-                    lines.append(f'{metric}{{jobid="{jid}"}} {v}')
+                    lines.append(
+                        f'{metric}{{jobid="{escape_label_value(jid)}"}} {v}')
             for metric, help_text in HISTOGRAMS.items():
-                lines.append(f"# HELP {metric} {help_text}")
+                lines.append(f"# HELP {metric} {escape_help(help_text)}")
                 lines.append(f"# TYPE {metric} histogram")
                 for (m, jid), h in sorted(self._hists.items()):
                     if m == metric:
@@ -276,7 +311,8 @@ class MetricsRegistry:
             lines.append(f"# HELP {RUNNING} Number of running tasks")
             lines.append(f"# TYPE {RUNNING} gauge")
             for kind, n in sorted(self._running.items()):
-                lines.append(f'{RUNNING}{{type="{kind}"}} {n}')
+                lines.append(
+                    f'{RUNNING}{{type="{escape_label_value(kind)}"}} {n}')
             source = self._serving_source
         # serving telemetry OUTSIDE the lock: the source snapshots each
         # decoder under its own lock and must not nest under ours. HELP/TYPE
@@ -289,19 +325,21 @@ class MetricsRegistry:
             except Exception:
                 per_model = {}
         for metric, (key, help_text) in SERVING_COUNTERS.items():
-            lines.append(f"# HELP {metric} {help_text}")
+            lines.append(f"# HELP {metric} {escape_help(help_text)}")
             lines.append(f"# TYPE {metric} counter")
             for model, snap in sorted(per_model.items()):
                 if key in snap:
-                    lines.append(f'{metric}{{model="{model}"}} {snap[key]}')
+                    lines.append(f'{metric}{{model='
+                                 f'"{escape_label_value(model)}"}} {snap[key]}')
         for metric, (key, help_text) in SERVING_GAUGES.items():
-            lines.append(f"# HELP {metric} {help_text}")
+            lines.append(f"# HELP {metric} {escape_help(help_text)}")
             lines.append(f"# TYPE {metric} gauge")
             for model, snap in sorted(per_model.items()):
                 if key in snap:
-                    lines.append(f'{metric}{{model="{model}"}} {snap[key]}')
+                    lines.append(f'{metric}{{model='
+                                 f'"{escape_label_value(model)}"}} {snap[key]}')
         for metric, (key, help_text) in SERVING_HISTOGRAMS.items():
-            lines.append(f"# HELP {metric} {help_text}")
+            lines.append(f"# HELP {metric} {escape_help(help_text)}")
             lines.append(f"# TYPE {metric} histogram")
             for model, snap in sorted(per_model.items()):
                 hist_snap = (snap.get("hist") or {}).get(key)
@@ -317,6 +355,14 @@ class MetricsRegistry:
 
             lines.extend(resilience.render_metrics())
         except Exception:  # exposition must never fail the scrape
+            pass
+        # data-plane byte accounting (utils.profiler): per-phase byte/second
+        # totals + the staging-bandwidth histogram, same one-scrape discipline
+        try:
+            from ..utils import profiler
+
+            lines.extend(profiler.render_metrics())
+        except Exception:
             pass
         return "\n".join(lines) + "\n"
 

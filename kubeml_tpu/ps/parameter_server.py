@@ -724,10 +724,16 @@ class ParameterServer:
 
     # --- traces (span collection; no reference counterpart) ---
 
-    def post_trace(self, task_id: str, spans: List[dict]) -> None:
+    def post_trace(self, task_id: str, spans: List[dict],
+                   counters: Optional[dict] = None,
+                   service: str = "") -> None:
         """``POST /traces/{taskId}``: a worker/job-runner process delivers its
-        finished spans for a task (utils.tracing.post_task_spans)."""
+        finished spans for a task (utils.tracing.post_task_spans), optionally
+        with its data-plane counter snapshot (the `kubeml profile` byte
+        budget per process)."""
         self.traces.add(task_id, spans)
+        if counters:
+            self.traces.add_counters(task_id, service or "worker", counters)
 
     def get_trace(self, task_id: str) -> dict:
         """The merged span set of a task: spans POSTed by remote processes
@@ -746,8 +752,19 @@ class ParameterServer:
             merged.append(d)
         merged.sort(key=lambda d: d.get("start", 0.0))
         trace_ids = sorted({d["trace_id"] for d in merged if d.get("trace_id")})
+        # counters: remote processes' snapshots plus this process's own (in
+        # the all-in-one cluster the control plane IS the local process)
+        counters = self.traces.get_counters(task_id)
+        try:
+            from ..utils import profiler
+
+            counters.setdefault(tracing.get_tracer().service or "ps",
+                                profiler.counters_snapshot())
+        except Exception:
+            pass
         return {"task_id": task_id, "trace_ids": trace_ids,
-                "dropped": self.traces.dropped(task_id), "spans": merged}
+                "dropped": self.traces.dropped(task_id), "spans": merged,
+                "counters": counters}
 
     # --- queries / control ---
 

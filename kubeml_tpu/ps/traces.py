@@ -27,6 +27,10 @@ class TraceStore:
         self.max_tasks = max_tasks
         self.max_spans_per_task = max_spans_per_task
         self._tasks: "OrderedDict[str, List[dict]]" = OrderedDict()
+        # per-task {service: data-plane counter snapshot} delivered WITH the
+        # spans (utils.profiler.counters_snapshot) — the `kubeml profile`
+        # report's per-process byte budgets; evicted with the task
+        self._counters: Dict[str, Dict[str, dict]] = {}
         self._dropped: Dict[str, int] = {}
         self._lock = threading.Lock()
 
@@ -40,6 +44,7 @@ class TraceStore:
                 while len(self._tasks) > self.max_tasks:
                     evicted, _ = self._tasks.popitem(last=False)
                     self._dropped.pop(evicted, None)
+                    self._counters.pop(evicted, None)
             for s in spans:
                 if not isinstance(s, dict):
                     continue
@@ -50,9 +55,30 @@ class TraceStore:
                     self._dropped[task_id] = self._dropped.get(task_id, 0) + 1
         return kept
 
+    def add_counters(self, task_id: str, service: str,
+                     counters: dict) -> None:
+        """Attach a process's data-plane counter snapshot to a task (latest
+        delivery per service label wins). Only tasks the store knows — or
+        has room for — are kept; same oldest-task eviction as spans."""
+        if not isinstance(counters, dict):
+            return
+        with self._lock:
+            if task_id not in self._tasks:
+                self._tasks[task_id] = []
+                while len(self._tasks) > self.max_tasks:
+                    evicted, _ = self._tasks.popitem(last=False)
+                    self._dropped.pop(evicted, None)
+                    self._counters.pop(evicted, None)
+            self._counters.setdefault(task_id, {})[str(service)] = counters
+
     def get(self, task_id: str) -> List[dict]:
         with self._lock:
             return list(self._tasks.get(task_id, ()))
+
+    def get_counters(self, task_id: str) -> Dict[str, dict]:
+        with self._lock:
+            return {svc: dict(c)
+                    for svc, c in self._counters.get(task_id, {}).items()}
 
     def dropped(self, task_id: str) -> int:
         with self._lock:
@@ -61,4 +87,5 @@ class TraceStore:
     def clear(self, task_id: str) -> None:
         with self._lock:
             self._tasks.pop(task_id, None)
+            self._counters.pop(task_id, None)
             self._dropped.pop(task_id, None)

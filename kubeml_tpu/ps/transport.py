@@ -86,7 +86,11 @@ class PSAPI:
             from ..api.errors import KubeMLError
 
             raise KubeMLError("trace payload must be {spans: [...]}", 400)
-        self.ps.post_trace(req.params["taskId"], spans)
+        counters = body.get("counters")
+        self.ps.post_trace(
+            req.params["taskId"], spans,
+            counters=counters if isinstance(counters, dict) else None,
+            service=str(body.get("service") or ""))
         return {"accepted": len(spans)}
 
     def _traces_get(self, req: Request):
@@ -158,9 +162,15 @@ class PSClient:
         return requests.get(f"{self.url}/metrics",
                             timeout=self._timeout()).text
 
-    def post_trace(self, task_id: str, spans: list) -> None:
+    def post_trace(self, task_id: str, spans: list,
+                   counters: Optional[dict] = None,
+                   service: str = "") -> None:
+        payload: dict = {"spans": spans}
+        if counters:
+            payload["counters"] = counters
+            payload["service"] = service or "worker"
         _check(requests.post(f"{self.url}/traces/{task_id}",
-                             json={"spans": spans}, timeout=self._timeout(),
+                             json=payload, timeout=self._timeout(),
                              idempotency_key=True))
 
     def get_trace(self, task_id: str) -> dict:

@@ -312,6 +312,7 @@ class BatchingDecoder:
                                   else cfg.serving_pipeline)
         self.fetchers = int(fetchers if fetchers is not None
                             else cfg.serving_fetchers)
+        self.stats.fetchers_total = self.fetchers
         self.pressure_sizing = bool(
             pressure_sizing if pressure_sizing is not None
             else cfg.serving_pressure_sizing)
@@ -935,10 +936,17 @@ class BatchingDecoder:
                 if item is None:
                     return
                 seq, rec = item
+                # pool observability: in-flight count + cumulative busy
+                # seconds (kubeml_serving_fetch* — the fetch pipeline is
+                # the binding constraint on tunneled hosts, SERVING_R5_NOTE)
+                self.stats.fetch_started()
+                t0 = time.monotonic()
                 try:
                     out = self._materialize(rec)
                 except Exception as e:  # surfaces on the engine thread
                     out = ("error", e)
+                finally:
+                    self.stats.fetch_finished(time.monotonic() - t0)
                 with done_cv:
                     done[seq] = out
                     done_cv.notify_all()

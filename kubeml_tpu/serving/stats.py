@@ -51,6 +51,14 @@ class DecoderStats:
         self.tokens_emitted = 0
         self.admission_waves = 0      # batched prefill+admit programs
         self.chunks = 0               # decode chunk programs
+        # fetcher pool (results/SERVING_R5_NOTE.md: short-request workloads
+        # are fetch-pipeline-bound on tunneled hosts): completed fetches,
+        # cumulative blocked wall seconds (rate/pool = utilization), live
+        # in-flight count, and the configured pool size (set by the engine)
+        self.fetches = 0
+        self.fetch_busy_seconds = 0.0
+        self.fetchers_inflight = 0
+        self.fetchers_total = 0
         self._lat: deque = deque(maxlen=LATENCY_RING)        # (total_s,)
         self._first: deque = deque(maxlen=LATENCY_RING)      # first-token s
         self._emits: deque = deque()  # (t, n_tokens) for the rate window
@@ -75,6 +83,16 @@ class DecoderStats:
     def chunk(self) -> None:
         with self._lock:
             self.chunks += 1
+
+    def fetch_started(self) -> None:
+        with self._lock:
+            self.fetchers_inflight += 1
+
+    def fetch_finished(self, seconds: float) -> None:
+        with self._lock:
+            self.fetchers_inflight = max(0, self.fetchers_inflight - 1)
+            self.fetches += 1
+            self.fetch_busy_seconds += float(seconds)
 
     def chunk_fetched(self, seconds: float, steps: int) -> None:
         """A decode chunk's results landed on the host: ``seconds`` is the
@@ -174,6 +192,13 @@ class DecoderStats:
                 "tokens_emitted": float(self.tokens_emitted),
                 "admission_waves": float(self.admission_waves),
                 "chunks": float(self.chunks),
+                "fetches": float(self.fetches),
+                "fetch_busy_seconds": float(self.fetch_busy_seconds),
+                "fetchers_inflight": float(self.fetchers_inflight),
+                "fetchers_total": float(self.fetchers_total),
+                "fetcher_utilization": (
+                    self.fetchers_inflight / self.fetchers_total
+                    if self.fetchers_total else 0.0),
             }
             hist = {}
             for key, h in (("first_token", self._hist_first),

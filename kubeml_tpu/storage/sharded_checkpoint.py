@@ -141,6 +141,7 @@ class ShardedCheckpointStore:
         # multi-file analogue of the flat store's os.replace atomicity.
         shard_path = d / f"shard-{proc}.npz"
         tmp = d / f".shard-{proc}.{uuid.uuid4().hex}.npz"
+        t0 = time.perf_counter()
         try:
             np.savez(tmp, **blobs)
             if barrier is not None:  # every process has staged its bytes
@@ -153,6 +154,14 @@ class ShardedCheckpointStore:
         except Exception:
             tmp.unlink(missing_ok=True)
             raise
+        # data-plane accounting: this process's checkpoint bytes + achieved
+        # write bandwidth (utils.profiler; barrier waits ride in the wall
+        # time deliberately — they ARE the observable save cost)
+        from ..utils import profiler
+
+        profiler.record_io(
+            "ckpt.save", sum(b.nbytes for b in blobs.values()),
+            time.perf_counter() - t0, job=job_id, tag=tag)
 
         if barrier is not None:
             barrier(f"ckpt/{job_id}/{tag}")
@@ -235,6 +244,7 @@ class ShardedCheckpointStore:
 
         from ..utils.jax_compat import make_array_from_callback
 
+        t_restore = time.perf_counter()
         d = self._dir(job_id, tag)
         mpath = d / MANIFEST
         if not mpath.exists():
@@ -313,6 +323,12 @@ class ShardedCheckpointStore:
                         shape, target, cb, dtype=dtype)
         finally:
             readers.close()
+        from ..utils import profiler
+
+        profiler.record_io(
+            "ckpt.restore",
+            sum(getattr(a, "nbytes", 0) for a in pairs.values()),
+            time.perf_counter() - t_restore, job=job_id, tag=tag)
         return ShardedCheckpoint(
             job_id=manifest.get("job_id", job_id),
             tag=manifest.get("tag", tag),

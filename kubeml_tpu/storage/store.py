@@ -79,6 +79,12 @@ class DatasetHandle:
             )
         x = self._load(split, "data")[lo:hi]
         y = self._load(split, "labels")[lo:hi]
+        # data-plane accounting: logical dataset bytes entering the input
+        # pipeline (mmap slices fault lazily, so bytes only — no blocking
+        # duration to turn into a bandwidth observation)
+        from ..utils import profiler
+
+        profiler.account("dataset.read", x.nbytes + y.nbytes)
         return x, y
 
     def summary(self) -> DatasetSummary:

@@ -4,9 +4,15 @@ The reference's env server reports unhandled exceptions to Sentry when
 SENTRY_DSN is set (reference: ml/environment/server.py:15-25). Egress-free
 equivalent: when ``KUBEML_ERROR_WEBHOOK`` is set, job failures POST a small
 JSON record to it (any collector — a Slack webhook, an alertmanager
-receiver, a log sink). Unset (the default), this module is a no-op; the
+receiver, a log sink). Unset (the default), no report is posted; the
 hook itself never raises and never blocks a failure path (fire-and-forget
 on a daemon thread with a short timeout).
+
+Independent of the webhook, every reported failure also trips the flight
+recorder (utils.profiler): with ``KUBEML_FLIGHT_DIR`` set, the ring of
+recent spans/data-plane events plus counter snapshots dumps to disk for
+postmortems, and webhook payloads carry the recorder tail correlated by
+trace_id.
 """
 
 from __future__ import annotations
@@ -26,6 +32,19 @@ def report_error(context: str, message: str, wait: bool = False,
     (bounded by the request timeout) — REQUIRED on paths that are about to
     ``os._exit`` (the stall watchdog), where a daemon thread would die with
     the process before the alert leaves it."""
+    # flight-recorder postmortem FIRST, independent of the webhook: the
+    # disk dump (gated by KUBEML_FLIGHT_DIR) must land even when no
+    # webhook is configured — crash evidence, not delivery decoration
+    flight_tail: list = []
+    flight_dump = None
+    try:
+        from .profiler import get_recorder
+
+        recorder = get_recorder()
+        flight_tail = recorder.tail(32)
+        flight_dump = recorder.dump(f"errorhook:{context}")
+    except Exception:
+        log.debug("flight recorder unavailable", exc_info=True)
     url = os.environ.get("KUBEML_ERROR_WEBHOOK", "")
     if not url:
         return
@@ -42,6 +61,11 @@ def report_error(context: str, message: str, wait: bool = False,
     task = current_task()
     if task is not None:
         payload.setdefault("task_id", task)
+    # the tail rides IN the report (correlated by the trace_id above)
+    if flight_tail:
+        payload.setdefault("flight_recorder", flight_tail)
+    if flight_dump is not None:
+        payload.setdefault("flight_dump", str(flight_dump))
 
     def post():
         try:

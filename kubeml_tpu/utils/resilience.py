@@ -96,6 +96,13 @@ COUNTER_HELP = {
         "service", "Responses served from the idempotency replay cache"),
     "kubeml_chaos_injected_total": (
         "mode", "Injected network faults by mode (delay/error/reset/client)"),
+    # byte-level data-plane accounting of the control plane itself
+    # (utils.traced_http): request/response payload sizes per route family,
+    # so weight/metric/span traffic is attributable from one scrape
+    "kubeml_http_sent_bytes_total": (
+        "route", "Outbound request payload bytes per route family"),
+    "kubeml_http_received_bytes_total": (
+        "route", "Inbound response payload bytes per route family"),
 }
 
 
@@ -128,6 +135,8 @@ def counters_snapshot() -> Dict[Tuple[str, str], float]:
 def render_metrics() -> List[str]:
     """Prometheus exposition lines for the resilience counters plus the live
     per-destination breaker-state gauge (0 closed, 1 half-open, 2 open)."""
+    from ..ps.metrics import escape_label_value  # exposition-format escaping
+
     snap = counters_snapshot()
     lines: List[str] = []
     for metric, (label, help_text) in COUNTER_HELP.items():
@@ -135,15 +144,16 @@ def render_metrics() -> List[str]:
         lines.append(f"# TYPE {metric} counter")
         for (m, value_label), v in sorted(snap.items()):
             if m == metric:
-                lines.append(f'{metric}{{{label}="{value_label}"}} {v:g}')
+                lines.append(f'{metric}{{{label}='
+                             f'"{escape_label_value(value_label)}"}} {v:g}')
     lines.append("# HELP kubeml_http_breaker_state Circuit-breaker state per "
                  "destination (0=closed, 1=half-open, 2=open)")
     lines.append("# TYPE kubeml_http_breaker_state gauge")
     with _registry_lock:
         breakers = sorted(_breakers.items())
     for dest, br in breakers:
-        lines.append(f'kubeml_http_breaker_state{{dest="{dest}"}} '
-                     f'{br.state_value}')
+        lines.append(f'kubeml_http_breaker_state{{dest='
+                     f'"{escape_label_value(dest)}"}} {br.state_value}')
     return lines
 
 

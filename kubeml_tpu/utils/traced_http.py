@@ -16,6 +16,11 @@ job runner → storage:
   read timeout``) is stamped as ``x-kubeml-deadline`` and the read timeout is
   clamped to the remaining budget, so a request chain can never outlive the
   caller that asked for it.
+* **byte accounting** — every hop's request/response payload sizes count into
+  ``kubeml_http_{sent,received}_bytes_total{route}`` (utils.resilience
+  counters, rendered on the PS ``/metrics``), so the control plane's own
+  data-plane cost — weight pushes, span deliveries, metric traffic — is
+  attributable per route family from one scrape.
 
 ``retryable=True``/``False`` overrides the per-method default (e.g. POST
 /infer is computationally pure and safe to retry without a key).
@@ -24,6 +29,7 @@ job runner → storage:
 from __future__ import annotations
 
 from typing import Optional
+from urllib.parse import urlsplit
 
 import requests
 
@@ -57,6 +63,36 @@ def timeouts(read: float, connect: Optional[float] = None) -> tuple:
     return (connect, read)
 
 
+def route_label(url: str) -> str:
+    """Bounded-cardinality route family of a URL: the first path segment
+    (``/update/job-17`` -> ``/update``) — ids never become label values."""
+    path = urlsplit(url).path or "/"
+    segments = [s for s in path.split("/") if s]
+    return f"/{segments[0]}" if segments else "/"
+
+
+def _account_bytes(url: str, resp: requests.Response,
+                   streamed: bool) -> None:
+    """Per-route payload byte accounting; body sizes come from the PREPARED
+    request (no re-serialization) and the buffered response. A streamed
+    response's body is NOT touched (reading it here would consume the
+    caller's iterator) — its Content-Length header counts when present."""
+    try:
+        route = route_label(url)
+        body = getattr(getattr(resp, "request", None), "body", None)
+        if body and isinstance(body, (bytes, str)):
+            resilience.incr("kubeml_http_sent_bytes_total", route, len(body))
+        if streamed:
+            received = int(resp.headers.get("Content-Length") or 0)
+        else:
+            received = len(resp.content) if resp.content else 0
+        if received:
+            resilience.incr("kubeml_http_received_bytes_total", route,
+                            received)
+    except Exception:  # accounting must never fail the request it measured
+        pass
+
+
 def request(method: str, url: str, *, retryable: Optional[bool] = None,
             idempotency_key=None, use_breaker: bool = True,
             **kwargs) -> requests.Response:
@@ -86,9 +122,11 @@ def request(method: str, url: str, *, retryable: Optional[bool] = None,
     if retryable is None:
         retryable = (method.upper() in resilience.IDEMPOTENT_METHODS
                      or idempotency_key is not None)
-    return resilience.resilient_request(
+    resp = resilience.resilient_request(
         method, url, retryable=retryable, deadline=deadline,
         stamp_origin=stamp_origin, use_breaker=use_breaker, **kwargs)
+    _account_bytes(url, resp, streamed=bool(kwargs.get("stream")))
+    return resp
 
 
 def get(url: str, **kwargs) -> requests.Response:

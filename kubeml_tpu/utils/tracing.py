@@ -219,6 +219,18 @@ class Span:
         }
 
 
+# finished-span observers (the flight recorder, utils.profiler): called with
+# each Span AFTER it is appended to the buffer. A sink must be cheap and must
+# never raise into the traced code path.
+_span_sinks: List = []
+
+
+def add_span_sink(fn) -> None:
+    """Register a finished-span observer (idempotent per function object)."""
+    if fn not in _span_sinks:
+        _span_sinks.append(fn)
+
+
 class Tracer:
     """Span recorder. Disabled by default: ``span()`` costs one attribute read."""
 
@@ -262,6 +274,11 @@ class Tracer:
             while len(self._spans) > MAX_SPANS:
                 self._spans.popleft()
                 self._dropped += 1
+        for sink in _span_sinks:
+            try:
+                sink(s)
+            except Exception:  # a broken observer must not fail traced code
+                pass
 
     def _identify(self, attrs: Dict[str, Any]) -> Span:
         """A new Span skeleton carrying trace identity: child of the thread's
@@ -411,7 +428,12 @@ def post_task_spans(ps_url: str, task_id: str,
                     tracer: Optional["Tracer"] = None) -> bool:
     """POST this process's finished spans for a task to the PS span collector
     (``/traces/{task_id}``). Fire-at-exit path for job runners / workers;
-    never raises. Returns whether anything was delivered."""
+    never raises. Returns whether anything was delivered.
+
+    The payload also carries this process's data-plane counter snapshot
+    (utils.profiler) keyed by the tracer's service label, so the
+    ``kubeml profile`` report sees every process's byte budget even where
+    individual spans carry no byte attributes."""
     tracer = tracer or get_tracer()
     if not tracer.enabled:
         return False
@@ -421,8 +443,16 @@ def post_task_spans(ps_url: str, task_id: str,
     try:
         from . import traced_http
 
+        payload = {"spans": spans}
+        try:
+            from . import profiler
+
+            payload["counters"] = profiler.counters_snapshot()
+            payload["service"] = tracer.service
+        except Exception:
+            pass
         traced_http.post(f"{ps_url}/traces/{task_id}",
-                         json={"spans": spans}, timeout=10)
+                         json=payload, timeout=10)
         return True
     except Exception:
         log.debug("posting %d spans for %s failed", len(spans), task_id,

@@ -79,6 +79,17 @@ def arm_stall_watchdog(job, timeout: float, what: str,
                     f"{allowed:g}s; KUBEML_FUNCTION_TIMEOUT) — terminating "
                     f"this process; {recovery}")
                 log.error("%s", reason)
+                # postmortem: dump the flight recorder (recent spans +
+                # counter snapshots, utils.profiler) before the process
+                # self-terminates — KUBEML_FLIGHT_DIR gates the disk write
+                try:
+                    from .profiler import get_recorder
+
+                    dump = get_recorder().dump(f"watchdog:{what}")
+                    if dump is not None:
+                        log.error("flight recorder dumped to %s", dump)
+                except Exception:
+                    log.debug("flight recorder dump failed", exc_info=True)
                 if on_stall is not None:
                     try:
                         on_stall(reason)

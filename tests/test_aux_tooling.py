@@ -15,9 +15,11 @@ def test_resource_sampler_writes_timeline(tmp_path):
 
     out = tmp_path / "usage.jsonl"
     with ResourceSampler(out, interval=0.2, tag="t1", devices=False):
-        # some busy work so cpu_util has something to see
+        # some busy work so cpu_util has something to see; the 2s window
+        # gives the sampler thread ~10 nominal ticks of margin — under gVisor
+        # CPU contention a 1s window occasionally yielded <3 samples (flake)
         t0 = time.time()
-        while time.time() - t0 < 1.0:
+        while time.time() - t0 < 2.0:
             sum(i * i for i in range(10000))
     rows = [json.loads(l) for l in out.read_text().splitlines()]
     assert len(rows) >= 3

@@ -1,0 +1,99 @@
+"""The bench regression gate (scripts/bench_compare.py) over the checked-in
+BENCH_r0*.json trajectory — the fast tier-1 wiring the gate is meant for."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+GATE = REPO / "scripts" / "bench_compare.py"
+
+
+def _run(*files, threshold=None):
+    cmd = [sys.executable, str(GATE)]
+    if threshold is not None:
+        cmd += ["--threshold", str(threshold)]
+    cmd += [str(f) for f in files]
+    return subprocess.run(cmd, capture_output=True, text=True, cwd=str(REPO))
+
+
+def test_real_r04_to_r05_pair_passes():
+    p = _run(REPO / "BENCH_r04.json", REPO / "BENCH_r05.json")
+    assert p.returncode == 0, p.stderr
+    report = json.loads(p.stdout)
+    assert report["pass"] is True
+    assert {c["metric"] for c in report["checks"]} == {
+        "device_samples_per_sec", "end_to_end_samples_per_sec", "mfu"}
+
+
+def test_full_trajectory_compares_last_pair():
+    files = sorted(REPO.glob("BENCH_r0*.json"))
+    assert len(files) >= 3, "trajectory fixture missing"
+    p = _run(*files)
+    assert p.returncode == 0, p.stderr
+    report = json.loads(p.stdout)
+    assert report["baseline_file"].endswith(files[-2].name)
+    assert report["candidate_file"].endswith(files[-1].name)
+    assert len(report["trajectory"]) == len(files)
+
+
+def test_synthetic_regression_fails_the_gate(tmp_path):
+    base = json.loads((REPO / "BENCH_r05.json").read_text())
+    cand = {"parsed": dict(base["parsed"])}
+    cand["parsed"]["value"] = base["parsed"]["value"] * 0.85  # -15% device
+    f = tmp_path / "cand.json"
+    f.write_text(json.dumps(cand))
+    p = _run(REPO / "BENCH_r05.json", f)
+    assert p.returncode == 1
+    report = json.loads(p.stdout)
+    assert report["pass"] is False
+    assert report["regressions"][0]["metric"] == "device_samples_per_sec"
+    # inside the threshold the same delta passes
+    assert _run(REPO / "BENCH_r05.json", f, threshold=0.20).returncode == 0
+
+
+def test_error_row_candidate_fails(tmp_path):
+    f = tmp_path / "err.json"
+    f.write_text(json.dumps({"metric": "x", "value": 0.0,
+                             "unit": "samples/sec", "vs_baseline": 0.0,
+                             "error": "accelerator backend unreachable"}))
+    p = _run(REPO / "BENCH_r05.json", f)
+    assert p.returncode == 1
+    assert "error row" in p.stderr
+
+
+def test_missing_mfu_is_skipped_not_failed(tmp_path):
+    rows = []
+    for v in (100.0, 99.0):
+        f = tmp_path / f"b{v}.json"
+        f.write_text(json.dumps({"metric": "m", "value": v,
+                                 "end_to_end": v, "mfu": None}))
+        rows.append(f)
+    p = _run(*rows)
+    assert p.returncode == 0, p.stderr
+    report = json.loads(p.stdout)
+    assert any(s["metric"] == "mfu" for s in report["skipped"])
+
+
+def test_nothing_comparable_is_a_distinct_failure(tmp_path):
+    f = tmp_path / "empty.json"
+    f.write_text(json.dumps({"metric": "m"}))
+    p = _run(f, f)
+    assert p.returncode == 2
+
+
+def test_normalize_bench_row_handles_both_forms():
+    from kubeml_tpu.benchmarks.harness import normalize_bench_row
+
+    wrapper = json.loads((REPO / "BENCH_r05.json").read_text())
+    row = normalize_bench_row(wrapper)
+    assert row["device_samples_per_sec"] == pytest.approx(32791.3)
+    assert row["end_to_end_samples_per_sec"] == pytest.approx(14810.5)
+    assert row["mfu"] == pytest.approx(0.4857)
+    raw = normalize_bench_row(wrapper["parsed"])
+    assert raw == row
+    err = normalize_bench_row({"metric": "m", "value": 0.0, "error": "boom"})
+    assert err["error"] == "boom" and err["mfu"] is None

@@ -170,8 +170,18 @@ def test_dashboard_metrics_exist_in_registry():
     stats.completed(0.2)
     stats.first_token(0.05)
     stats.chunk_fetched(0.1, 10)
+    stats.fetch_started()
+    stats.fetch_finished(0.01)
     reg.set_serving_source(lambda: {"m": stats.snapshot()})
-    text = reg.render()
+    # one blocking data-plane transfer so the staging-bandwidth _bucket
+    # series renders (the dashboard's bandwidth quantile panel queries it)
+    from kubeml_tpu.utils import profiler
+
+    profiler.account("dash-test", 1000, 0.1)
+    try:
+        text = reg.render()
+    finally:
+        profiler.reset_accounting()
     d = json.loads((REPO / "deploy/grafana/kubeml-dashboard.json").read_text())
     import re
 
