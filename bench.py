@@ -216,13 +216,27 @@ def main():
 
         # end-to-end throughput: every round staged host->device over this
         # box's tunnel (uint8 quantized, dequantized on device by
-        # KubeModel.preprocess)
+        # KubeModel.preprocess), through the ENGINE's own prefetcher
+        # (engine/kavg.RoundPrefetcher, KUBEML_DATAPLANE_PREFETCH — default
+        # double buffering): round i+1's slabs are dispatched before round
+        # i's program, so the transfer overlaps the compute wherever the
+        # platform's DMA allows instead of serializing with it. Using the
+        # real prefetcher keeps the benchmark measuring the epoch loop's
+        # actual staging discipline, not a hand-rolled copy of it.
+        from types import SimpleNamespace
+
+        from kubeml_tpu.engine.kavg import RoundPrefetcher
+
+        rb = SimpleNamespace(x=x, y=y, mask=mask)
         for _ in range(reps):
             t0 = time.perf_counter()
-            for i in range(rounds):
-                sx, sy, sm = trainer.stage_round(x, y, mask, n_workers)
+            prefetched = RoundPrefetcher(
+                trainer, (rb for _ in range(rounds)), n_workers)
+            for i, (rbi, staged) in enumerate(prefetched):
+                cur = staged if staged is not None else trainer.stage_round(
+                    rbi.x, rbi.y, rbi.mask, n_workers)
                 variables, loss = trainer.sync_round(
-                    variables, sx, sy, sm, jax.random.fold_in(rng, i), lr=0.1
+                    variables, *cur, jax.random.fold_in(rng, i), lr=0.1
                 )
             float(loss)
             dt = time.perf_counter() - t0

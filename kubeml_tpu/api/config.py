@@ -226,6 +226,22 @@ class Config:
             )
         os.chmod(d, 0o700)  # exist_ok path: enforce even if created looser
         return d / f"{job_id}.sock"
+    # --- weight-movement data plane (engine/dataplane.py) ---
+    # wire codec for the PS<->runner weight exchange: "raw" (full binary
+    # snapshots), "delta" (lossless — only changed leaves ship), or
+    # "delta-int8" (int8-quantized round deltas with an error-feedback
+    # residual, per-channel scales per ops/int8_matmul.py — ~4x on the
+    # dominant f32 leaves at bounded, non-accumulating reconstruction error)
+    dataplane_codec: str = field(
+        default_factory=lambda: os.environ.get("KUBEML_DATAPLANE_CODEC",
+                                               "delta"))
+    # rounds the training loop stages ahead of the one computing (host->HBM
+    # slab prefetch, engine/kavg.RoundPrefetcher): 1 = double buffering (the
+    # default), 0 = stage synchronously per round, >1 deepens the pipeline
+    # for links whose transfer time exceeds a round's compute
+    dataplane_prefetch: int = field(
+        default_factory=lambda: _env_int("KUBEML_DATAPLANE_PREFETCH", 1))
+
     # persistent XLA compilation cache: elastic re-meshes recompile per worker
     # count and standalone job runners are fresh processes — both hit this disk
     # cache instead of recompiling (SURVEY §7 "elastic parallelism vs XLA").

@@ -357,6 +357,16 @@ class MetricUpdate(_JsonMixin):
     # -1 = not measured (e.g. an engine that doesn't time it)
     round_seconds: List[float] = field(default_factory=list)
     merge_seconds: float = -1.0
+    # data-plane counter deltas riding the epoch push as SEQUENCED batches
+    # ([{"seq": n, "phases": {phase: {bytes, seconds, events}}}, ...]):
+    # standalone runners expose no scraped /metrics route, so their
+    # encode-side dataplane counters (weights.encode.*, staging,
+    # checkpoint I/O) fold into the PS registry here. The runner queues a
+    # batch per push and drops the queue only on a client-observed success;
+    # the PS applies each (job, seq) at most once — so a push it processed
+    # whose response was lost re-delivers the same seqs without
+    # double-counting, and a push it never saw re-delivers until acked
+    dataplane: List[Dict[str, Any]] = field(default_factory=list)
 
 
 @dataclass

@@ -37,7 +37,7 @@ from ..storage.history import HistoryStore
 from ..storage.store import ShardStore
 from ..utils.tracing import get_tracer
 from .failures import FailureInjector, WorkerHealth
-from .kavg import KAvgTrainer
+from .kavg import KAvgTrainer, RoundPrefetcher
 
 log = logging.getLogger("kubeml.job")
 
@@ -400,21 +400,14 @@ class TrainJob:
         # latency-histogram feeds, reset per epoch (pushed with MetricUpdate)
         self._last_round_times = []
         self._last_merge_s = -1.0
-        # double-buffered staging: each round's slabs are device_put one round
-        # ahead, so the host->HBM transfer of round i+1 overlaps round i's
-        # compute (stage_round never blocks; parallelism is fixed within an
-        # epoch so the ahead-staging target sharding is always right)
-        rounds_it = iter(loader)
-        current = next(rounds_it, None)
-        staged = None if current is None else self.trainer.stage_round(
-            current.x, current.y, current.mask, self.parallelism
-        )
-        while current is not None:
-            rb, rb_staged = current, staged
-            current = next(rounds_it, None)
-            staged = None if current is None else self.trainer.stage_round(
-                current.x, current.y, current.mask, self.parallelism
-            )
+        # prefetched staging (engine/kavg.RoundPrefetcher): each round's
+        # slabs are device_put KUBEML_DATAPLANE_PREFETCH rounds ahead
+        # (default 1 = double buffering), so the host->HBM transfer of round
+        # i+1 overlaps round i's compute (stage_round never blocks;
+        # parallelism is fixed within an epoch so the ahead-staging target
+        # sharding is always right)
+        for rb, rb_staged in RoundPrefetcher(self.trainer, loader,
+                                             self.parallelism):
             if self._sync_stop():
                 break
             worker_mask = None

@@ -79,10 +79,34 @@ class JobRunner:
         # Started lazily in _start — only K-AVG jobs publish into it.
         self._tensor_store = None
         self._tensor_server = None
+        # the HTTP weight seam (engine/dataplane.WeightsWire): the same
+        # per-epoch reference weights, binary-encoded with the configured
+        # codec, served on GET /weights — the delta-compressed fallback when
+        # the native socket is off or unbuilt (it used to be HTTP-JSON
+        # /infer payload round-trips)
+        self._weights_wire = None
+        # writer-side delta state for the tensor-store channel: unchanged
+        # leaves skip the socket write and keep their old manifest version
+        self._publish_state = None
+        # at most one publish runs at a time, OFF the training thread; a
+        # publish superseded while queued is dropped (only the newest
+        # epoch's weights matter to the serving path)
+        self._publish_pending = None
+        self._publish_thread: Optional[threading.Thread] = None
         # a FRESH box per epoch-end request: a late answer for epoch N must not
         # satisfy epoch N+1's wait (the PS allocates per-request _UpdateBoxes
         # for the same reason)
         self._update_box: Optional[list] = None  # [Event, parallelism]
+        # dataplane counter hand-off to the PS (this process has no scraped
+        # /metrics route — the epoch push is how weights.encode.* reaches
+        # the PS exposition): each push cuts the delta since the last cut
+        # into a SEQUENCED batch; unacked batches re-ride every push until
+        # a client-observed success, and the PS applies each seq at most
+        # once — neither a lost request nor a lost response can drop or
+        # double-count bytes
+        self._dp_cut: dict = {}  # counter snapshot at the last batch cut
+        self._dp_unacked: list = []  # [{"seq", "phases"}] awaiting PS ack
+        self._dp_seq = 0
         self._lock = threading.Lock()
 
         router = Router(f"job-{job_id}")
@@ -91,6 +115,7 @@ class JobRunner:
         router.route("DELETE", "/stop", self._stop)
         router.route("POST", "/infer", self._infer)
         router.route("POST", "/generate", self._generate)
+        router.route("GET", "/weights", self._weights)
         router.route("GET", "/state", self._state)
         self.service = Service(router, self.cfg.host, port)
 
@@ -117,18 +142,98 @@ class JobRunner:
                           "fallback remains)")
 
     def _publish_weights(self, variables: dict, epoch: int) -> None:
-        from ..native.weights import publish_variables
+        """Epoch-weights hook, called on the TRAINING thread with a host
+        snapshot. The publish itself (hashing, socket writes, wire encode)
+        runs on a background thread so the next epoch's rounds dispatch
+        while the weights move — weight publication is off the critical
+        path. Queued-but-superseded publishes are dropped: only the newest
+        epoch matters to the serving channel."""
+        with self._lock:
+            self._publish_pending = (variables, epoch)
+            # the worker only exits after clearing _publish_thread under
+            # THIS lock with pending empty, so a non-None handle means the
+            # fresh item will be drained — no lost-wakeup race
+            if self._publish_thread is not None:
+                return
+            self._publish_thread = threading.Thread(
+                target=self._publish_worker, name=f"publish-{self.job_id}",
+                daemon=True)
+            self._publish_thread.start()
+
+    def _publish_worker(self) -> None:
+        from ..engine.dataplane import WeightsWire
+        from ..native.weights import PublishState, publish_variables
         from ..utils import tracing
 
-        store = self._tensor_store
-        if store is not None:  # racing shutdown: silently skip
-            # spanned so the per-epoch weight publication shows up in the
-            # task's span tree (publish_variables itself accounts the bytes
-            # and bandwidth — utils.profiler)
-            with tracing.get_tracer().span("runner.publish_weights",
-                                           service="worker",
-                                           job=self.job_id, epoch=epoch):
-                publish_variables(store, variables, epoch + 1)
+        while True:
+            with self._lock:
+                item = self._publish_pending
+                self._publish_pending = None
+                if item is None:
+                    self._publish_thread = None
+                    return
+            variables, epoch = item
+            try:
+                with tracing.use_context(self._trace_ctx), \
+                        tracing.bind_task(self.job_id), \
+                        tracing.get_tracer().span("runner.publish_weights",
+                                                  service="worker",
+                                                  job=self.job_id,
+                                                  epoch=epoch):
+                    store = self._tensor_store
+                    if store is not None:  # racing shutdown: silently skip
+                        if self._publish_state is None:
+                            self._publish_state = PublishState()
+                        # delta publish: unchanged leaves skip the store
+                        # write and keep their old manifest leaf version
+                        # (publish_variables accounts bytes + bandwidth)
+                        publish_variables(store, variables, epoch + 1,
+                                          state=self._publish_state)
+                    wire = self._weights_wire
+                    if wire is None:
+                        wire = self._weights_wire = WeightsWire()
+                    wire.publish(variables, epoch + 1)
+            except Exception:
+                log.exception("%s: weight publish failed (non-fatal)",
+                              self.job_id)
+
+    def _join_publisher(self, timeout: float = 60.0) -> None:
+        with self._lock:
+            thread = self._publish_thread
+            self._publish_pending = None
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=timeout)
+
+    def _weights(self, req):
+        """``GET /weights[?since=N]`` — the live reference weights as one
+        binary dataplane payload (docs/api.md wire conventions): the delta
+        against ``since`` when the caller is exactly one version behind, a
+        full snapshot otherwise, 204 when current. Replaces JSON-of-floats
+        payload round-trips on the PS serving seam."""
+        from ..api.errors import KubeMLError
+        from ..engine import dataplane
+        from ..utils.httpd import Response
+
+        wire = self._weights_wire
+        if wire is None:
+            raise KubeMLError(
+                f"job {self.job_id} has published no weights yet", 404)
+        since = req.arg("since")
+        try:
+            since = int(since) if since is not None else None
+        except ValueError:
+            raise KubeMLError(f"invalid since={since!r}", 400)
+        got = wire.get(since)
+        if got is None:
+            raise KubeMLError(
+                f"job {self.job_id} has published no weights yet", 404)
+        payload, version = got
+        headers = {dataplane.VERSION_HEADER: str(version)}
+        if payload == "current":
+            return Response(b"", status=204, headers=headers,
+                            content_type=dataplane.CONTENT_TYPE)
+        return Response(payload, content_type=dataplane.CONTENT_TYPE,
+                        headers=headers)
 
     # --- routes ---
 
@@ -161,7 +266,11 @@ class JobRunner:
             extra = {}
             if job_cls is TrainJob and self.cfg.tensor_sockets:
                 self._start_tensor_server()
-            if job_cls is TrainJob and self._tensor_store is not None:
+            if job_cls is TrainJob:
+                # always publish epoch weights: even without the native
+                # socket, the HTTP /weights seam serves the delta-encoded
+                # binary payload the PS pulls (engine/dataplane.py) — the
+                # JSON /infer round-trip is the last resort, not the plan
                 extra["on_epoch_weights"] = self._publish_weights
             self.job = job_cls(
                 self.job_id, request, model,
@@ -311,15 +420,36 @@ class JobRunner:
                     self._update_box = None  # late answers hit the warning path
 
     def _push_metrics(self, update) -> None:
+        from ..utils import profiler
         from ..utils import traced_http as requests
 
+        snap = profiler.counters_snapshot()["dataplane"]
+        phases = {}
+        for phase, agg in snap.items():
+            prev = self._dp_cut.get(phase, {})
+            delta = {k: max(agg[k] - prev.get(k, 0), 0)
+                     for k in ("bytes", "seconds", "events")}
+            if any(delta.values()):
+                phases[phase] = delta
+        if phases:
+            self._dp_seq += 1
+            self._dp_unacked.append({"seq": self._dp_seq, "phases": phases})
+            del self._dp_unacked[:-64]  # PS gone for 64 epochs: shed oldest
+            self._dp_cut = {p: dict(a) for p, a in snap.items()}
+        update.dataplane = list(self._dp_unacked)
         try:
-            requests.post(f"{self.cfg.ps_url}/metrics/{self.job_id}",
-                          json=update.to_dict(),
-                          timeout=requests.timeouts(5),
-                          idempotency_key=True)
+            r = requests.post(f"{self.cfg.ps_url}/metrics/{self.job_id}",
+                              json=update.to_dict(),
+                              timeout=requests.timeouts(5),
+                              idempotency_key=True)
         except requests.RequestException:
             log.debug("job %s: metrics push failed (PS down?)", self.job_id)
+        else:
+            # only a 2xx is an ack: traced_http RETURNS retryable-status
+            # responses (429 overload, 504 deadline, chaos 500) instead of
+            # raising, and a batch cleared on one of those vanished forever
+            if r.status_code < 300:
+                self._dp_unacked.clear()
 
     def _notify_ps_finished(self) -> None:
         from ..utils import traced_http as requests
@@ -345,19 +475,22 @@ class JobRunner:
 
     def stop(self) -> None:
         self.service.stop()
-        if self._tensor_store is not None:
-            # the training thread publishes into the store at epoch ends:
-            # freeing the native handle under it would be a use-after-free,
-            # so detach the store reference FIRST (the publisher checks it),
-            # then wait for the thread before freeing
-            store, self._tensor_store = self._tensor_store, None
-            if self.thread is not None and self.thread.is_alive():
-                if self.job is not None:
-                    self.job.stop()
-                self.thread.join(timeout=60.0)
-            if self._tensor_server is not None:
-                self._tensor_server.stop()
-                self._tensor_server = None
+        # the publish worker writes into the tensor store at epoch ends:
+        # freeing the native handle under it would be a use-after-free, so
+        # detach the store reference FIRST (the publisher checks it), then
+        # quiesce the TRAINING thread (it is what enqueues publishes — a
+        # live one could respawn the worker right after a join), then the
+        # publish worker, and only then free the store
+        store, self._tensor_store = self._tensor_store, None
+        if self.thread is not None and self.thread.is_alive():
+            if self.job is not None:
+                self.job.stop()
+            self.thread.join(timeout=60.0)
+        self._join_publisher()
+        if self._tensor_server is not None:
+            self._tensor_server.stop()
+            self._tensor_server = None
+        if store is not None:
             store.close()
         try:
             self.cfg.job_socket_path(self.job_id).unlink(missing_ok=True)

@@ -88,6 +88,57 @@ def _broadcast_to_workers(tree, n: int):
     return jax.tree.map(lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), tree)
 
 
+class RoundPrefetcher:
+    """Stage round slabs ahead of the round computing (data-plane overlap).
+
+    Iterates ``(round_batch, staged_slabs)`` over a RoundLoader-style
+    iterable, keeping up to ``depth`` FUTURE rounds' slabs dispatched via
+    ``trainer.stage_round`` (which never blocks — the host->HBM DMA rides
+    under the current round's compute). ``depth=1`` is classic double
+    buffering (the engine default, ``KUBEML_DATAPLANE_PREFETCH``);
+    ``depth=0`` yields ``staged=None`` and the consumer stages
+    synchronously — the old unoverlapped behavior, kept for debugging;
+    deeper pipelines help when one transfer takes longer than one round's
+    compute (the dev tunnel), at the cost of ``depth`` extra slabs of HBM.
+
+    Parallelism must be fixed while iterating (an epoch's invariant — the
+    engine re-meshes only at epoch boundaries, so the ahead-staged sharding
+    is always right)."""
+
+    def __init__(self, trainer: "KAvgTrainer", rounds, n_workers: int,
+                 depth: Optional[int] = None):
+        if depth is None:
+            from ..api.config import get_config
+
+            depth = get_config().dataplane_prefetch
+        self.trainer = trainer
+        self.rounds = rounds
+        self.n_workers = n_workers
+        self.depth = max(0, int(depth))
+
+    def __iter__(self):
+        from collections import deque
+
+        it = iter(self.rounds)
+        if self.depth == 0:
+            for rb in it:
+                yield rb, None
+            return
+        buf: deque = deque()
+        exhausted = False
+        while True:
+            while not exhausted and len(buf) < self.depth + 1:
+                rb = next(it, None)
+                if rb is None:
+                    exhausted = True
+                    break
+                buf.append((rb, self.trainer.stage_round(
+                    rb.x, rb.y, rb.mask, self.n_workers)))
+            if not buf:
+                return
+            yield buf.popleft()
+
+
 class KAvgTrainer:
     """Owns compiled train/eval programs for one model across parallelism levels."""
 

@@ -230,6 +230,11 @@ class MetricsRegistry:
         # eviction past MAX_HISTOGRAM_JOBS
         self._hists: Dict[Tuple[str, str], Histogram] = {}
         self._running: Dict[str, int] = {"train": 0, "inference": 0}
+        # per-job high-water mark of applied dataplane delta batches
+        # (MetricUpdate.dataplane seqs): a redelivered batch — the runner
+        # re-sends until a client-observed ack — must fold into the
+        # profiler registry at most once. Insertion-ordered, oldest-evicted.
+        self._dp_applied: Dict[str, int] = {}
         # () -> {model_id: telemetry dict} from the PS's resident decoders
         # (serving/batcher.telemetry); set by the PS, read at render time
         self._serving_source = None
@@ -239,6 +244,33 @@ class MetricsRegistry:
 
     def update(self, u: MetricUpdate) -> None:
         """Per-epoch push from a job (reference: metrics.go:90-98)."""
+        if u.dataplane:
+            # a standalone runner's dataplane counter delta batches (it has
+            # no scraped /metrics of its own): fold into this process's
+            # registry so weights.encode.* reaches the exposition. Batches
+            # already applied (seq <= high-water mark) are redeliveries of
+            # a push whose response was lost — skip, or the Grafana
+            # compression panels would overcount. In-process jobs share
+            # the registry and push no batches.
+            from ..utils import profiler
+
+            with self._lock:
+                applied = self._dp_applied.get(u.job_id, 0)
+                fresh = [b for b in u.dataplane if isinstance(b, dict)
+                         and int(b.get("seq", 0)) > applied]
+                if fresh:
+                    self._dp_applied.pop(u.job_id, None)  # re-insert as newest
+                    self._dp_applied[u.job_id] = max(
+                        int(b["seq"]) for b in fresh)
+                    # backstop only (primary cleanup is clear() at job
+                    # finish): evicting a LIVE job's mark would let its
+                    # still-redelivered batches re-fold and overcount, so
+                    # the bound is sized far above plausible concurrent
+                    # pushers and trips only if jobs leak without finishing
+                    while len(self._dp_applied) > 4096:
+                        self._dp_applied.pop(next(iter(self._dp_applied)))
+            for b in fresh:
+                profiler.merge_counters(b.get("phases") or {})
         with self._lock:
             jid = u.job_id
             self._values[("kubeml_job_validation_loss", jid)] = u.validation_loss
@@ -282,6 +314,11 @@ class MetricsRegistry:
         with self._lock:
             for key in [k for k in self._values if k[1] == job_id]:
                 del self._values[key]
+            # the runner exits with its job, so redeliveries of its
+            # dataplane batches stop here — dropping the seq high-water
+            # mark now is what keeps the bounded map from ever evicting a
+            # LIVE job's mark (which would double-count redelivered bytes)
+            self._dp_applied.pop(job_id, None)
 
     def task_started(self, kind: str = "train") -> None:
         with self._lock:

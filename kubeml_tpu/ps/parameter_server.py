@@ -106,7 +106,18 @@ class ParameterServer:
         self._jobs: Dict[str, _JobRecord] = {}
         self._monitor: Optional[threading.Thread] = None  # standalone liveness watch
         self._serving_cache: Dict[str, tuple] = {}  # (model, vars, ckpt mtime)
-        self._socket_cache: Dict[str, tuple] = {}  # (model, vars, epoch version)
+        # (model, vars, epoch version, native.weights.FetchCache) — the
+        # FetchCache makes per-epoch refreshes pull only the leaves whose
+        # manifest version moved (delta fetch)
+        self._socket_cache: Dict[str, tuple] = {}
+        # HTTP weight seam (engine/dataplane): (model, vars, DeltaDecoder)
+        # per live standalone job — the decoder holds the synced tree the
+        # runner's delta payloads chain against. The decoder is STATEFUL, so
+        # pull+decode serializes on a per-model lock (requests arrive on
+        # ThreadingHTTPServer threads; two threads decoding the same delta
+        # into one decoder would double-apply it)
+        self._wire_cache: Dict[str, tuple] = {}
+        self._wire_locks: Dict[str, threading.Lock] = {}
         self._decoders: Dict[str, tuple] = {}  # (BatchingDecoder, ckpt mtime)
         self._ckpt_store = CheckpointStore(config=self.cfg)
         from .journal import JobJournal
@@ -202,6 +213,7 @@ class ParameterServer:
             self._jobs[task.job_id] = placeholder
             self._serving_cache.pop(task.job_id, None)
             self._socket_cache.pop(task.job_id, None)
+            self._wire_cache.pop(task.job_id, None)
         try:
             self._journal.record(task.job_id, task.parameters)
         except Exception:
@@ -655,6 +667,8 @@ class ParameterServer:
                 return False
             self._jobs.pop(job_id, None)
             self._socket_cache.pop(job_id, None)  # socket dies with the runner
+            self._wire_cache.pop(job_id, None)  # so does the /weights route
+            self._wire_locks.pop(job_id, None)
         if not record.keep_journal:
             try:
                 self._journal.clear(job_id)
@@ -848,7 +862,18 @@ class ParameterServer:
                 if out is not None:
                     return out
             except Exception:
-                log.debug("tensor-socket infer for %s failed; HTTP fallback",
+                log.debug("tensor-socket infer for %s failed; wire fallback",
+                          model_id, exc_info=True)
+            # second choice: pull the weights themselves over HTTP as one
+            # binary dataplane payload (delta-encoded against what we hold —
+            # engine/dataplane.py) and serve locally; the JSON /infer
+            # round-trip below is the last resort
+            try:
+                out = self._infer_from_wire(model_id, record, data)
+                if out is not None:
+                    return out
+            except Exception:
+                log.debug("weight-wire infer for %s failed; HTTP fallback",
                           model_id, exc_info=True)
             from ..utils import traced_http as requests
 
@@ -1041,7 +1066,8 @@ class ParameterServer:
         if not sock.exists():
             return None
         from ..native.bindings import TensorClient
-        from ..native.weights import fetch_variables, read_version
+        from ..native.weights import (FetchCache, fetch_variables,
+                                      read_version)
 
         with self._lock:
             cached = self._socket_cache.get(model_id)
@@ -1054,14 +1080,98 @@ class ParameterServer:
                 if cached is None:
                     return None
             elif cached is None or cached[2] != version:
-                variables, version = fetch_variables(client)
+                # delta fetch: the FetchCache keeps last epoch's leaves, so
+                # only leaves whose manifest version moved cross the socket
+                fetch_cache = cached[3] if cached is not None else FetchCache()
+                variables, version = fetch_variables(client, cache=fetch_cache)
                 if variables is None:
                     return None
                 model = self.registry.load(record.task.parameters.function_name)
-                cached = (model, variables, version)
+                cached = (model, variables, version, fetch_cache)
                 with self._lock:
                     self._socket_cache[model_id] = cached
         model, variables = cached[0], cached[1]
+        self.metrics.task_started("inference")
+        try:
+            x = model.preprocess(jnp.asarray(np.asarray(data)))
+            return np.asarray(model.infer(variables, x)).tolist()
+        finally:
+            self.metrics.task_finished("inference")
+
+    def _infer_from_wire(self, model_id: str, record, data) -> Optional[list]:
+        """Serve a live standalone job by pulling its weights over the HTTP
+        binary seam (``GET /weights`` — engine/dataplane wire format) and
+        running the model locally. Returns None when the runner has nothing
+        published (the caller then falls back to the JSON /infer
+        round-trip). A repeat pull while we are current costs one 204; a
+        one-epoch-stale cache costs the delta payload, not the tree."""
+        import jax.numpy as jnp
+
+        from ..engine import dataplane
+        from ..engine.dataplane import BaseVersionMismatch, DeltaDecoder
+        from ..utils import traced_http as requests
+
+        with self._lock:
+            wire_lock = self._wire_locks.setdefault(model_id, threading.Lock())
+            cached = self._wire_cache.get(model_id)
+        # the GET runs OUTSIDE the per-model lock: only decode + cache-swap
+        # needs serializing, and holding the lock across a network round
+        # trip (60s read timeout; the steady-state 204 check included)
+        # would cap the model's ENTIRE serving path at one request per
+        # runner response — every ThreadingHTTPServer thread queueing
+        # behind one slow /weights answer
+        since_v = cached[2].version if cached is not None else None
+        url = f"{record.url}/weights"
+        since = f"?since={since_v}" if since_v is not None else ""
+        r = requests.get(url + since, timeout=requests.timeouts(60),
+                         retryable=True)
+        if r.status_code == 404:
+            return None  # nothing published yet
+        if r.status_code >= 400:
+            from ..api.errors import error_from_envelope
+
+            raise error_from_envelope(r.content, r.status_code)
+        if r.status_code == 204:
+            # only reachable with a cached decoder: ``since`` is sent iff
+            # the decoder has a version, i.e. it decoded into the cache
+            # before, and ``cached`` is our own pre-GET snapshot (a racing
+            # thread advancing the cache meanwhile just makes this serve
+            # one version stale — still an internally consistent tree)
+            model, variables = cached[0], cached[1]
+        else:
+            target = int(r.headers.get(dataplane.VERSION_HEADER, "0"))
+            # load the model BEFORE decoding: decode() advances the SHARED
+            # cached decoder in place (atomically — state lands only on
+            # success), so anything that can raise after it would leave the
+            # decoder ahead of the cached variables and every later
+            # ?since= would 204 into silently stale serves
+            model = self.registry.load(record.task.parameters.function_name)
+            with wire_lock:
+                # re-read under the lock: another thread may have decoded
+                # while our GET was in flight — its payload and ours carry
+                # the same delta, and double-applying a delta into the
+                # stateful decoder would corrupt the chain
+                with self._lock:
+                    cached = self._wire_cache.get(model_id)
+                decoder = cached[2] if cached is not None else DeltaDecoder()
+                if cached is not None and decoder.version == target:
+                    model, variables = cached[0], cached[1]
+                else:
+                    try:
+                        variables, _version = decoder.decode(r.content)
+                    except BaseVersionMismatch:
+                        # the runner no longer serves a delta against our
+                        # version (it only keeps one step): full snapshot,
+                        # fresh chain (rare resync — worth the lock)
+                        decoder = DeltaDecoder()
+                        r = requests.get(url, timeout=requests.timeouts(60),
+                                         retryable=True)
+                        if r.status_code >= 400:
+                            return None
+                        variables, _version = decoder.decode(r.content)
+                    with self._lock:
+                        self._wire_cache[model_id] = (model, variables,
+                                                      decoder)
         self.metrics.task_started("inference")
         try:
             x = model.preprocess(jnp.asarray(np.asarray(data)))

@@ -60,6 +60,11 @@ _lock = threading.Lock()
 _phases: Dict[str, Dict[str, float]] = {}
 # {phase: Histogram of achieved bytes/sec for BLOCKING transfers}
 _bw_hists: Dict[str, Any] = {}
+# {phase: retry count} — transfers that had to be re-attempted (e.g. a
+# torn seqlock weight fetch); the wasted bytes land on their own phase
+# (weights.fetch_torn) so the attribution report can't under-count the
+# channel, and this counter says how often
+_retries: Dict[str, int] = {}
 
 
 def account(phase: str, nbytes: float, seconds: Optional[float] = None) -> None:
@@ -109,6 +114,15 @@ def record_io(phase: str, nbytes: float, seconds: float,
         tracer.record(phase, max(float(seconds or 0.0), 0.0), **span_attrs)
 
 
+def record_retry(phase: str) -> None:
+    """Count one retried data-plane transfer on ``phase`` (rendered as
+    ``kubeml_dataplane_retries_total``). O(1), never raises."""
+    with _lock:
+        if phase not in _retries and len(_retries) >= MAX_PHASES:
+            _retries.pop(next(iter(_retries)))
+        _retries[phase] = _retries.get(phase, 0) + 1
+
+
 def counters_snapshot() -> Dict[str, Any]:
     """Plain-data snapshot of the data-plane accounting (per-phase byte/
     second/event totals + bandwidth histogram snapshots) — posted with a
@@ -125,8 +139,31 @@ def counters_snapshot() -> Dict[str, Any]:
             "pid": os.getpid(),
             "dataplane": {p: dict(agg) for p, agg in _phases.items()},
             "bandwidth": {p: h.snapshot() for p, h in _bw_hists.items()},
+            "retries": dict(_retries),
         }
     return out
+
+
+def merge_counters(phases: Dict[str, Dict[str, float]]) -> None:
+    """Fold per-phase counter DELTAS from another process into this
+    registry. The runner->PS epoch metric push uses this: a standalone job
+    runner has no scraped ``/metrics`` route, so its dataplane counters
+    (``weights.encode.*`` and friends) would otherwise never reach the one
+    exposition Prometheus scrapes. Bandwidth histograms stay per-process
+    (deltas of bucket vectors are not carried on the push)."""
+    for phase, d in phases.items():
+        if not isinstance(d, dict):
+            continue
+        with _lock:
+            agg = _phases.get(phase)
+            if agg is None:
+                if len(_phases) >= MAX_PHASES:
+                    _phases.pop(next(iter(_phases)))
+                agg = _phases[phase] = {"bytes": 0.0, "seconds": 0.0,
+                                        "events": 0}
+            agg["bytes"] += max(float(d.get("bytes", 0.0)), 0.0)
+            agg["seconds"] += max(float(d.get("seconds", 0.0)), 0.0)
+            agg["events"] += max(int(d.get("events", 0)), 0)
 
 
 def reset_accounting() -> None:
@@ -134,6 +171,7 @@ def reset_accounting() -> None:
     with _lock:
         _phases.clear()
         _bw_hists.clear()
+        _retries.clear()
 
 
 def render_metrics() -> List[str]:
@@ -144,6 +182,7 @@ def render_metrics() -> List[str]:
     with _lock:
         phases = {p: dict(agg) for p, agg in _phases.items()}
         hists = {p: h.snapshot() for p, h in _bw_hists.items()}
+        retries = dict(_retries)
     lines = [
         "# HELP kubeml_dataplane_bytes_total Bytes moved per data-plane phase",
         "# TYPE kubeml_dataplane_bytes_total counter",
@@ -163,6 +202,14 @@ def render_metrics() -> List[str]:
     for p, agg in sorted(phases.items()):
         lines.append(f'kubeml_dataplane_events_total{{phase="'
                      f'{escape_label_value(p)}"}} {agg["events"]:d}')
+    if retries:
+        lines.append("# HELP kubeml_dataplane_retries_total Re-attempted "
+                     "data-plane transfers per phase (e.g. torn weight "
+                     "fetches)")
+        lines.append("# TYPE kubeml_dataplane_retries_total counter")
+        for p, n in sorted(retries.items()):
+            lines.append(f'kubeml_dataplane_retries_total{{phase="'
+                         f'{escape_label_value(p)}"}} {n:d}')
     lines.append("# HELP kubeml_staging_bandwidth_bytes_per_sec Achieved "
                  "bandwidth of blocking data-plane transfers")
     lines.append("# TYPE kubeml_staging_bandwidth_bytes_per_sec histogram")

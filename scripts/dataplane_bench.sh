@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# Weight-movement data-plane bench: per-round PS<->runner weight-exchange
+# bytes by codec (raw / delta / delta-int8), appended to
+# results/dataplane_bench.jsonl, then gated against the BENCH_r05 baseline
+# through scripts/bench_compare.py so a codec regression fails loudly.
+#
+#   scripts/dataplane_bench.sh [rounds]     (default 12)
+#
+# Three acts:
+#  1. benchmarks/dataplane_bench.py — a real K-AVG training loop where every
+#     round's reference weights round-trip encoder -> payload -> decoder and
+#     training CONTINUES from the decoded tree: measured bytes/round,
+#     compression ratio, and the final loss proving the delta-int8 error
+#     feedback stayed convergent. Also emits per-codec projected-e2e rows
+#     (the r05 staging budget scaled by the measured byte ratio — labeled a
+#     projection; the real number comes from the next chip bench).
+#  2. bench_compare: BENCH_r05 as baseline vs the delta-int8 projected row
+#     as candidate — exits non-zero (failing this script) if the codec's
+#     projected end-to-end throughput regresses the recorded 14.8k.
+#  3. The acceptance check itself: delta-int8 bytes/round must be >= 3x
+#     smaller than raw at a final loss within tolerance of the raw run.
+#
+# On a CPU dev box the light flagship keeps a run under a minute
+# (KUBEML_FLAGSHIP=lenet); unset it on a chip host for resnet-sized trees.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p results
+
+ROUNDS="${1:-12}"
+
+# --- act 1: measured codec rows + projections ---
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" KUBEML_FLAGSHIP="${KUBEML_FLAGSHIP:-lenet}" \
+python -m kubeml_tpu.benchmarks.dataplane_bench --rounds "$ROUNDS" \
+  --out results/dataplane_bench.jsonl | tee /tmp/dataplane_bench_rows.jsonl
+
+# --- act 2: the r05 gate — a codec regression must fail loudly ---
+python - <<'EOF'
+import json
+
+rows = [json.loads(l) for l in open("/tmp/dataplane_bench_rows.jsonl")]
+cand = next(r for r in rows if r["kind"] == "projected-e2e"
+            and r["codec"] == "delta-int8")
+json.dump(cand, open("/tmp/dataplane_candidate.json", "w"))
+EOF
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+python scripts/bench_compare.py BENCH_r05.json /tmp/dataplane_candidate.json \
+  --out /tmp/dataplane_gate.json
+
+# --- act 3: acceptance — >=3x bytes cut at unchanged final loss ---
+python - <<'EOF'
+import json, math, sys
+
+rows = [json.loads(l) for l in open("/tmp/dataplane_bench_rows.jsonl")]
+by = {r["codec"]: r for r in rows if r["kind"] == "dataplane-codec"}
+raw, q8 = by["raw"], by["delta-int8"]
+ratio = raw["bytes_per_round"] / q8["bytes_per_round"]
+dloss = abs(q8["final_loss"] - raw["final_loss"])
+# "unchanged final loss" yardstick: the quantized chain may lag the exact
+# chain by LESS THAN ONE ROUND of optimization progress (plus a small
+# absolute floor for flat tails) — a diverging chain blows straight past
+# this; a tracking chain sits inside the raw run's last round step
+traj = raw.get("loss_trajectory") or [raw["final_loss"]]
+one_round = abs(traj[-2] - traj[-1]) if len(traj) > 1 else 0.0
+tol = max(one_round, 0.05 * abs(raw["final_loss"]), 0.02)
+print(f"delta-int8 vs raw: {ratio:.2f}x fewer bytes/round "
+      f"({raw['bytes_per_round']:.0f} -> {q8['bytes_per_round']:.0f}), "
+      f"final loss {raw['final_loss']:.4f} -> {q8['final_loss']:.4f} "
+      f"(|d|={dloss:.4f}, tol {tol:.4f} = max(one-round progress, 5%)), "
+      f"chain mismatch {q8['chain_mismatch']:.2e}")
+# encoder/decoder are bit-identical stateful mirrors: any nonzero chain
+# mismatch means the delta chain is silently diverging, even if this short
+# run's loss still lands inside tol
+ok = ratio >= 3.0 and dloss <= tol and q8["chain_mismatch"] == 0.0
+if not ok:
+    print("FAIL: dataplane acceptance (>=3x at unchanged loss) not met",
+          file=sys.stderr)
+    sys.exit(1)
+print("dataplane acceptance PASSED")
+EOF
+
+echo "rows appended to results/dataplane_bench.jsonl; gate report in" \
+     "/tmp/dataplane_gate.json"

@@ -178,6 +178,9 @@ def test_dashboard_metrics_exist_in_registry():
     from kubeml_tpu.utils import profiler
 
     profiler.account("dash-test", 1000, 0.1)
+    # and one retried transfer: kubeml_dataplane_retries_total renders only
+    # when a retry happened (the dashboard's torn-fetch panel queries it)
+    profiler.record_retry("dash-test")
     try:
         text = reg.render()
     finally:
