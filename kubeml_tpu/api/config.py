@@ -112,6 +112,50 @@ class Config:
         default_factory=lambda: float(os.environ.get("KUBEML_BREAKER_COOLDOWN", "5.0"))
     )
 
+    # --- multi-tenant preemption (scheduler/preemption.py + ps.preempt_task) ---
+    # seconds a preempted job gets to checkpoint-and-yield cooperatively
+    # before the hard-kill escalation (safe: checkpoint publish is atomic, so
+    # a SIGKILL mid-yield costs at most the epochs since the newest
+    # checkpoint — the same guarantee the chaos suite proves for crashes)
+    preempt_grace: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_PREEMPT_GRACE", "60"))
+    )
+    # run the preemption controller (watches the serving overload signals and
+    # reclaims capacity from the lowest-priority running job); off by default
+    # — colocating serving and training is an explicit deployment decision
+    preempt_monitor: bool = field(
+        default_factory=lambda: _env_bool("KUBEML_PREEMPT_MONITOR"))
+    # controller poll period (seconds)
+    preempt_interval: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_PREEMPT_INTERVAL", "1.0"))
+    )
+    # overload signal thresholds (any crossing counts as serving pressure):
+    # queued decode rows (the serving queue-depth gauge)...
+    preempt_queue_depth: int = field(
+        default_factory=lambda: _env_int("KUBEML_PREEMPT_QUEUE_DEPTH", 8))
+    # ...429s/sec over the controller's sliding window (requests_overload rate)...
+    preempt_overload_rate: float = field(
+        default_factory=lambda: float(
+            os.environ.get("KUBEML_PREEMPT_OVERLOAD_RATE", "1.0"))
+    )
+    # ...and serving request p99 seconds (kubeml_serving_request_seconds
+    # quantile source; 0 disables the latency signal)
+    preempt_p99: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_PREEMPT_P99", "0"))
+    )
+    # consecutive overloaded polls before reclaiming, and consecutive calm
+    # polls before a preempted job is requeued (hysteresis: one noisy sample
+    # must neither kill a training run nor thrash it back into the burst)
+    preempt_sustain: int = field(
+        default_factory=lambda: _env_int("KUBEML_PREEMPT_SUSTAIN", 3))
+    preempt_resume_sustain: int = field(
+        default_factory=lambda: _env_int("KUBEML_PREEMPT_RESUME_SUSTAIN", 5))
+    # seconds between successive preemptions (one reclaim must get the chance
+    # to relieve pressure before the next victim is chosen)
+    preempt_cooldown: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_PREEMPT_COOLDOWN", "30"))
+    )
+
     # --- function execution guardrails (reference cmd/function.go:234-262:
     # per-function concurrency 50, execution timeout 1000s) ---
     # seconds a user-code call (function load, traced user module, a job

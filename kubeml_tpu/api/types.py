@@ -23,6 +23,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from .errors import KubeMLError
+
 # Defaults mirroring reference ml/pkg/api/const.go:16 (DefaultParallelism = 5) —
 # except on TPU parallelism moves in topology-legal steps, so the default is a
 # power of two that tiles a v5e-8 slice cleanly.
@@ -53,6 +55,11 @@ class JobStateEnum:
     FINISHED = "finished"
     FAILED = "failed"
     STOPPED = "stopped"
+    # checkpoint-and-yield: the job wrote a checkpoint and returned its
+    # devices under multi-tenant pressure; the preemption controller requeues
+    # it with resume=True once pressure clears (unlike STOPPED, this is the
+    # system's decision, and unlike FAILED, the work is intact)
+    PREEMPTED = "preempted"
 
 
 class _JsonMixin:
@@ -132,6 +139,17 @@ class TrainOptions(_JsonMixin):
     save_model: bool = True  # export the final model at job end (enables later infer)
     # --- fault injection (chaos testing; the reference only mentions chaos-monkey) ---
     chaos_prob: float = 0.0  # per-worker per-round failure probability
+    # --- multi-tenant scheduling (scheduler/queue.py, scheduler/preemption.py) ---
+    # priority class: higher pops first from the scheduler queue, and the
+    # preemption controller reclaims capacity from the LOWEST-priority
+    # running job when serving overloads. 0 = best-effort (preemptible),
+    # larger = more latency-critical; bounded so a client can't mint an
+    # unbeatable class by accident
+    priority: int = 0
+    # fair-share tenant: within one priority class, queued work of the
+    # tenant with the least accumulated device-seconds pops first (empty =
+    # the anonymous shared tenant)
+    tenant: str = ""
 
     def __post_init__(self):
         if self.goal_loss < 0.0:
@@ -148,6 +166,11 @@ class TrainOptions(_JsonMixin):
             raise ValueError("chaos_prob must be in [0, 1]")
         if self.k == 0 or self.k < -1:
             raise ValueError("k must be -1 (sparse) or a positive step count")
+        if (isinstance(self.priority, bool) or not isinstance(self.priority, int)
+                or not (0 <= self.priority <= 1000)):
+            raise ValueError("priority must be an integer in [0, 1000]")
+        if self.tenant and not re.fullmatch(r"[A-Za-z0-9._-]{1,64}", self.tenant):
+            raise ValueError("tenant must be 1-64 chars of [A-Za-z0-9._-]")
         if self.mesh_shape is not None:
             for axis, size in self.mesh_shape.items():
                 if not isinstance(size, int) or size < 1:
@@ -302,6 +325,21 @@ def generate_timeout(req: "GenerateRequest", floor: float = 120.0) -> float:
     except TypeError:
         pass
     return max(floor, 60.0 + 0.05 * req.max_new_tokens * batch)
+
+
+def parse_grace_seconds(grace) -> Optional[float]:
+    """Validate the optional ``grace`` field of a preempt request body:
+    None passes through (server default), otherwise it must be a
+    non-negative number — a 400, not a 500, on garbage, and no silent
+    negative that would turn the cooperative yield into an instant kill."""
+    if grace is None:
+        return None
+    if isinstance(grace, bool) or not isinstance(grace, (int, float)):
+        raise KubeMLError("grace must be a number of seconds", 400)
+    grace = float(grace)
+    if not (grace >= 0.0):  # rejects negatives AND NaN
+        raise KubeMLError("grace must be >= 0 seconds", 400)
+    return grace
 
 
 @dataclass

@@ -85,6 +85,8 @@ def cmd_train(args) -> int:
             chaos_prob=args.chaos_prob,
             engine=args.engine,
             mesh_shape=mesh_shape,
+            priority=args.priority,
+            tenant=args.tenant,
         ),
     )
     # with KUBEML_TRACE set the CLI contributes the trace ROOT: the submit
@@ -297,8 +299,39 @@ def cmd_task(args) -> int:
     elif args.action == "stop":
         c.stop(args.id)
         print(f"stopped {args.id}")
+    elif args.action == "preempt":
+        c.preempt(args.id, reason=args.reason, grace=args.grace)
+        print(f"preempting {args.id} (checkpoint-and-yield)")
     elif args.action == "prune":
         print(f"pruned {c.prune()} tasks")
+    return 0
+
+
+# --- jobs: the multi-tenant operator view (queued/running/preempted) ---
+
+
+def cmd_jobs(args) -> int:
+    """``kubeml jobs``: queued (pop order), running, and preempted jobs with
+    priority, tenant, and — for preempted jobs — the epoch resume restarts
+    at. The visibility preemption debugging needs in one listing."""
+    jobs = _client(args).tasks().jobs()
+    if args.json:
+        _print(jobs)
+        return 0
+    if not jobs:
+        print("no jobs")
+        return 0
+    cols = ("JOB", "STATUS", "PRIO", "TENANT", "FUNCTION", "RESUME@")
+    rows = [(j.get("job_id", ""), j.get("status", ""),
+             str(j.get("priority", 0)), j.get("tenant", "") or "-",
+             j.get("function", "") or "-",
+             str(j["resume_epoch"]) if "resume_epoch" in j else "-")
+            for j in jobs]
+    widths = [max(len(c), *(len(r[i]) for r in rows))
+              for i, c in enumerate(cols)]
+    print("  ".join(c.ljust(widths[i]) for i, c in enumerate(cols)))
+    for r in rows:
+        print("  ".join(v.ljust(widths[i]) for i, v in enumerate(r)))
     return 0
 
 
@@ -548,6 +581,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-worker per-round failure injection probability")
     t.add_argument("--engine", choices=["kavg", "spmd"], default="kavg",
                    help="kavg = elastic local-SGD; spmd = multi-axis mesh (LLMs)")
+    t.add_argument("--priority", type=int, default=0,
+                   help="priority class 0-1000 (higher schedules first; the "
+                        "preemption controller reclaims from the lowest)")
+    t.add_argument("--tenant", default="",
+                   help="fair-share tenant (least accumulated device-seconds "
+                        "pops first within a priority class)")
     t.add_argument("--mesh", default=None,
                    help="spmd mesh axes, e.g. tp=2,sp=2 (rest of devices -> dp)")
     t.set_defaults(fn=cmd_train)
@@ -624,8 +663,22 @@ def build_parser() -> argparse.ArgumentParser:
     kl.add_argument("--short", action="store_true")
     ks = ksub.add_parser("stop")
     ks.add_argument("--id", required=True)
+    kp = ksub.add_parser("preempt",
+                         help="checkpoint-and-yield a running job (it is "
+                              "requeued with resume=True)")
+    kp.add_argument("--id", required=True)
+    kp.add_argument("--reason", default="operator")
+    kp.add_argument("--grace", type=float, default=None,
+                    help="seconds before the hard-kill escalation "
+                         "(default: KUBEML_PREEMPT_GRACE)")
     ksub.add_parser("prune")
     k.set_defaults(fn=cmd_task)
+
+    j = sub.add_parser("jobs",
+                       help="queued/running/preempted jobs with priority, "
+                            "tenant, and resume epoch")
+    j.add_argument("--json", action="store_true", help="raw JSON output")
+    j.set_defaults(fn=cmd_jobs)
 
     h = sub.add_parser("history", help="training histories")
     hsub = h.add_subparsers(dest="action", required=True)

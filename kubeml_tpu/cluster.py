@@ -66,6 +66,17 @@ class LocalCluster:
         )
         self.scheduler = Scheduler(self.ps, config=self.cfg)
         self.ps.bind_scheduler(self.scheduler)
+        # multi-tenant preemption controller (KUBEML_PREEMPT_MONITOR): watches
+        # the serving overload signals and checkpoint-and-yields the lowest-
+        # priority training job; preempted jobs park here until pressure
+        # clears, then requeue with resume=True
+        self.preemption = None
+        if self.cfg.preempt_monitor:
+            from .scheduler.preemption import PreemptionController
+
+            self.preemption = PreemptionController(
+                self.scheduler, self.ps, config=self.cfg)
+            self.scheduler.preemption = self.preemption
         self.controller = Controller(
             self.scheduler,
             self.ps,
@@ -81,6 +92,12 @@ class LocalCluster:
     def start(self, recover: bool = True) -> "LocalCluster":
         self.cfg.enable_compilation_cache()
         self.scheduler.start()
+        if self.preemption is not None:
+            self.preemption.start()
+            log.info("preemption controller running (queue>=%d, 429/s>=%g, "
+                     "p99>=%gs; grace %gs)", self.cfg.preempt_queue_depth,
+                     self.cfg.preempt_overload_rate, self.cfg.preempt_p99,
+                     self.cfg.preempt_grace)
         if self.serve_http:
             self.controller.start()
             self.storage_service = StorageService(store=self.store, config=self.cfg).start()
@@ -101,6 +118,8 @@ class LocalCluster:
         return self
 
     def stop(self) -> None:
+        if self.preemption is not None:
+            self.preemption.stop()
         self.ps.shutdown_standalone_jobs()
         # stop threaded jobs BEFORE the shutdown announcement: a running
         # multi-host job holds the dist lock for its whole duration, and its

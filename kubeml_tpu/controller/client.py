@@ -183,6 +183,24 @@ class _Tasks:
     def stop(self, job_id: str) -> None:
         _check(requests.delete(f"{self.c.url}/tasks/{job_id}", timeout=requests.timeouts(self.c.timeout)))
 
+    def preempt(self, job_id: str, reason: str = "operator",
+                grace: Optional[float] = None) -> None:
+        """Checkpoint-and-yield a running job: it writes a resume checkpoint,
+        exits `preempted`, and is requeued with resume=True (immediately, or
+        once pressure clears when the preemption controller is running)."""
+        body: dict = {"reason": reason}
+        if grace is not None:
+            body["grace"] = grace
+        _check(requests.post(f"{self.c.url}/tasks/{job_id}/preempt",
+                             json=body,
+                             timeout=requests.timeouts(self.c.timeout),
+                             idempotency_key=True))
+
+    def jobs(self) -> List[dict]:
+        """The merged queued/running/preempted listing (`kubeml jobs`)."""
+        return _check(requests.get(f"{self.c.url}/jobs",
+                                   timeout=requests.timeouts(self.c.timeout)))
+
     def prune(self) -> int:
         return _check(requests.delete(f"{self.c.url}/tasks", timeout=requests.timeouts(self.c.timeout)))["pruned"]
 

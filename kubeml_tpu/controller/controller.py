@@ -18,7 +18,8 @@ from typing import Optional
 
 from ..api.config import Config, get_config
 from ..api.errors import KubeMLError
-from ..api.types import GenerateRequest, InferRequest, TrainRequest
+from ..api.types import (GenerateRequest, InferRequest, TrainRequest,
+                         parse_grace_seconds)
 from ..functions.registry import FunctionRegistry
 from ..storage.checkpoint import CheckpointStore
 from ..storage.history import HistoryStore
@@ -55,8 +56,10 @@ class Controller:
         router.route("POST", "/dataset/{name}", self._dataset_create)
         router.route("DELETE", "/dataset/{name}", self._dataset_delete)
         router.route("GET", "/tasks", self._tasks)
+        router.route("GET", "/jobs", self._jobs)
         router.route("DELETE", "/tasks", self._task_prune)
         router.route("DELETE", "/tasks/{id}", self._task_stop)
+        router.route("POST", "/tasks/{id}/preempt", self._task_preempt)
         router.route("GET", "/tasks/{id}/trace", self._task_trace)
         router.route("GET", "/history", self._history_list)
         router.route("GET", "/history/{id}", self._history_get)
@@ -132,9 +135,30 @@ class Controller:
     def _tasks(self, req: Request):
         return [t.to_dict() for t in self.ps.list_tasks()]
 
+    def _jobs(self, req: Request):
+        """Operator view for `kubeml jobs`: queued (scheduler queue, in pop
+        order with priority/tenant), running (PS index), and preempted
+        (journaled-but-not-live, with the epoch resume restarts at) — the
+        visibility preemption debugging needs, in one merged listing."""
+        queued = self.scheduler.jobs_snapshot()
+        seen = {j["job_id"] for j in queued}
+        # a requeued job can be both queued AND still journaled; queued wins
+        rest = [j for j in self.ps.jobs_snapshot() if j["job_id"] not in seen]
+        return queued + rest
+
     def _task_stop(self, req: Request):
         self.ps.stop_task(req.params["id"])
         return {}
+
+    def _task_preempt(self, req: Request):
+        """Checkpoint-and-yield a running job (body: {"reason", "grace"})."""
+        body = req.json() or {}
+        self.ps.preempt_task(
+            req.params["id"],
+            reason=str(body.get("reason") or "operator"),
+            grace=parse_grace_seconds(body.get("grace")),
+        )
+        return {"status": "preempting"}
 
     def _task_prune(self, req: Request):
         return {"pruned": self.ps.prune_tasks()}

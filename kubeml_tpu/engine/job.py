@@ -130,6 +130,14 @@ class TrainJob:
         self.history = History(id=job_id, task={"request": request.to_dict()})
         self.history.notes.extend(self._pending_notes)
         self.stop_event = threading.Event()
+        # checkpoint-and-yield (multi-tenant preemption): preempt() rides the
+        # stop machinery — every round/epoch boundary and dist broadcast that
+        # honors stop_event honors preemption too — but the exit differs: a
+        # preempted job writes a resume checkpoint instead of a final export,
+        # and reports the `preempted` terminal status so the scheduler
+        # requeues it with resume=True when pressure clears
+        self.preempt_event = threading.Event()
+        self.preempt_requested_at: Optional[float] = None
         # progress stamp for the PS heartbeat monitor (function guardrails):
         # a job whose user code hangs inside a traced program goes stale here
         # and is failed by the monitor instead of wedging its thread forever.
@@ -152,6 +160,18 @@ class TrainJob:
 
     def stop(self) -> None:
         self.stop_event.set()
+
+    def preempt(self) -> None:
+        """Checkpoint-and-yield: exit at the next round boundary, write a
+        resume checkpoint, report the ``preempted`` status. Idempotent."""
+        if self.preempt_requested_at is None:
+            self.preempt_requested_at = time.time()
+        self.preempt_event.set()
+        self.stop_event.set()
+
+    @property
+    def preempted(self) -> bool:
+        return self.preempt_event.is_set()
 
     @property
     def checkpoint_store(self) -> CheckpointStore:
@@ -206,6 +226,13 @@ class TrainJob:
                 elapsed = time.time() - t0
                 if self.stop_event.is_set() and np.isnan(train_loss):
                     break  # stopped mid-epoch before any round completed
+                # fast-yield gate, SINGLE-HOST only: the blocks below contain
+                # collectives (validation, the elastic broadcast, checkpoint
+                # snapshots), and in dist mode preempt_event is leader-local —
+                # a one-sided skip would strand the followers; dist yields at
+                # the granularity the stop broadcast already provides
+                yielding = self.preempt_event.is_set() and (
+                    self.dist is None or self.dist.size == 1)
 
                 # health-based re-mesh (SURVEY §7 "partial failure inside
                 # collectives"): persistently dead workers shrink the mesh at
@@ -237,7 +264,11 @@ class TrainJob:
                 # this epoch's elapsed time unless parallelism is static. The
                 # leader asks (its elapsed time stands for the job) and the
                 # answer is broadcast so every process re-meshes identically.
-                if not opts.static_parallelism and (
+                # Skipped when preempting: the answer is unused (the loop
+                # exits) and the scheduler round-trip would delay the yield.
+                # Lockstep-safe: the round loop's _sync_stop broadcast means
+                # every process agrees on the stop flag by this point.
+                if not opts.static_parallelism and not yielding and (
                     self.on_epoch_end is not None or self.dist is not None
                 ):
                     new_p = None
@@ -273,10 +304,12 @@ class TrainJob:
                         # consecutive-failure counts must not transfer
                         self.health.reset()
 
-                # periodic validation (job.go:223-243)
+                # periodic validation (job.go:223-243) — skipped mid-yield: a
+                # preempting job must release the devices, not run an eval sweep
                 val_loss = None
                 acc_pct = None
-                if opts.validate_every > 0 and (epoch + 1) % opts.validate_every == 0:
+                if (opts.validate_every > 0 and not yielding
+                        and (epoch + 1) % opts.validate_every == 0):
                     val_acc, val_loss = self._validate(dataset, handle)
                     acc_pct = val_acc * 100.0
 
@@ -291,7 +324,10 @@ class TrainJob:
                 if self._leader:
                     self._push_metrics(train_loss, val_loss, acc_pct, elapsed,
                                        used_parallelism)
-                if opts.checkpoint_every > 0 and (epoch + 1) % opts.checkpoint_every == 0:
+                if (opts.checkpoint_every > 0 and not yielding
+                        and (epoch + 1) % opts.checkpoint_every == 0):
+                    # preempting: redundant with the synchronous yield
+                    # checkpoint written at exit (same epoch, same weights)
                     self._save_checkpoint(epoch)
                 if self.on_epoch_weights is not None and self.dist is None:
                     try:
@@ -341,7 +377,12 @@ class TrainJob:
             # util.go:211-244 — here a finished job stays inferable/exportable).
             # A no-op resume skips the rewrite unless no final export exists yet
             # (crash after the last epoch checkpoint but before the final save).
-            if self._leader and opts.save_model and (
+            # A PREEMPTED job writes a resume checkpoint instead: it is parked,
+            # not done — a FINAL export would make the id serve mid-training
+            # weights as "the model" and slow the yield with a second write.
+            if self.preempt_event.is_set():
+                self._save_yield_checkpoint()
+            elif self._leader and opts.save_model and (
                 epochs_run > 0 or FINAL_TAG not in self.checkpoint_store.tags(self.job_id)
             ):
                 self.checkpoint_store.save(
@@ -704,6 +745,33 @@ class TrainJob:
                 self._ckpt_thread.start()
         except Exception:
             log.exception("%s: checkpoint save failed (non-fatal)", self.job_id)
+
+    def _save_yield_checkpoint(self) -> None:
+        """Yield checkpoint for a preempted job: the CURRENT reference weights
+        tagged with the last completed epoch — resume then restarts the
+        following epoch, identical semantics to a checkpoint_every save (a
+        pre-existing checkpoint at that epoch is refreshed with the extra
+        mid-epoch progress). Synchronous by design: the devices are released
+        only after the checkpoint is durably published, and the store's
+        tmp+rename publish is atomic, so even a hard kill mid-yield leaves
+        either the old or the new checkpoint — never a torn one."""
+        if not self._leader:
+            return
+        completed = len(self.history.train_loss)
+        if completed <= 0:
+            return  # nothing completed yet: resume restarts from scratch/prior
+        self.heartbeat = time.time()
+        try:
+            with self.tracer.span("job.yield_checkpoint", service="worker",
+                                  job=self.job_id, epoch=completed - 1):
+                self.checkpoint_store.save(
+                    self.job_id, self._final_variables, epoch=completed - 1,
+                    meta={"request": self.request.to_dict(),
+                          "history": self._history_lists()},
+                )
+        except Exception:
+            log.exception("%s: yield checkpoint failed (resume falls back to "
+                          "the previous checkpoint)", self.job_id)
 
     def _restore_latest(self) -> int:
         """Restore the newest checkpoint (selection shared with the SPMD

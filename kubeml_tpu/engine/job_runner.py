@@ -113,6 +113,7 @@ class JobRunner:
         router.route("POST", "/start", self._start)
         router.route("POST", "/update", self._update)
         router.route("DELETE", "/stop", self._stop)
+        router.route("POST", "/preempt", self._preempt)
         router.route("POST", "/infer", self._infer)
         router.route("POST", "/generate", self._generate)
         router.route("GET", "/weights", self._weights)
@@ -311,7 +312,11 @@ class JobRunner:
             with tracing.use_context(self._trace_ctx), \
                     tracing.bind_task(self.job_id):
                 self.job.train()
-            self.status = "stopped" if self.job.stop_event.is_set() else "finished"
+            if getattr(self.job, "preempted", False):
+                self.status = "preempted"
+            else:
+                self.status = ("stopped" if self.job.stop_event.is_set()
+                               else "finished")
         except Exception as e:
             self.status = "failed"
             self.exit_error = str(e)
@@ -351,6 +356,22 @@ class JobRunner:
             raise JobNotFoundError(self.job_id)
         self.request_stop()
         return {}
+
+    def _preempt(self, req):
+        """``POST /preempt`` — checkpoint-and-yield: the job exits at the
+        next round boundary, writes a resume checkpoint, and reports the
+        ``preempted`` terminal status to the PS (which keeps the journal
+        entry so the scheduler can requeue it with resume=True). Idempotent:
+        a redelivered preempt on an already-yielding job is a no-op."""
+        from ..api.errors import JobNotFoundError
+
+        if self.job is None:
+            raise JobNotFoundError(self.job_id)
+        self.job.preempt()
+        with self._lock:
+            if self._update_box is not None:
+                self._update_box[0].set()  # unblock a pending epoch-end wait
+        return {"status": "preempting"}
 
     def _infer(self, req):
         import numpy as np

@@ -116,6 +116,12 @@ class SPMDJob:
 
         self.history = History(id=job_id, task={"request": request.to_dict()})
         self.stop_event = threading.Event()
+        # checkpoint-and-yield (multi-tenant preemption): preempt() rides the
+        # stop machinery — same boundaries, same dist broadcast — but the
+        # exit writes a resume checkpoint instead of the final export and
+        # reports the `preempted` terminal status
+        self.preempt_event = threading.Event()
+        self.preempt_requested_at: Optional[float] = None
         # progress stamp for the PS heartbeat monitor (function guardrails).
         # heartbeat_cold doubles the monitor's allowance while the first
         # step's XLA compile runs (minutes on chip); cleared after it lands
@@ -145,6 +151,18 @@ class SPMDJob:
 
     def stop(self) -> None:
         self.stop_event.set()
+
+    def preempt(self) -> None:
+        """Checkpoint-and-yield: exit at the next step/epoch boundary, write
+        a resume checkpoint, report the ``preempted`` status. Idempotent."""
+        if self.preempt_requested_at is None:
+            self.preempt_requested_at = time.time()
+        self.preempt_event.set()
+        self.stop_event.set()
+
+    @property
+    def preempted(self) -> bool:
+        return self.preempt_event.is_set()
 
     @property
     def state(self) -> JobState:
@@ -228,9 +246,15 @@ class SPMDJob:
 
                 used_devices = self.mesh.devices.size
 
+                # validation is skipped mid-yield — SINGLE-HOST only: in dist
+                # mode preempt_event may be set on the leader alone mid-epoch
+                # (stop broadcasts at the loop top), and validation is a
+                # collective, so a one-sided skip would strand the followers
                 val_loss = None
                 acc_pct = None
-                if opts.validate_every > 0 and (epoch + 1) % opts.validate_every == 0:
+                skip_val = self.preempt_event.is_set() and not dist_multi
+                if (opts.validate_every > 0 and not skip_val
+                        and (epoch + 1) % opts.validate_every == 0):
                     val_loss, token_acc = self._validate()
                     if token_acc is not None:
                         acc_pct = token_acc * 100.0
@@ -283,7 +307,23 @@ class SPMDJob:
                     if new_p:
                         self._maybe_remesh(new_p, rng, first)
 
-            if opts.save_model and self.history.train_loss:
+            # the save branches below contain COLLECTIVES (gathers, sharded
+            # barriers): in dist mode every process must take the same one,
+            # and mid-epoch preempt_event is leader-local — broadcast the
+            # leader's decision first
+            preempted = self.preempt_event.is_set()
+            if dist_multi:
+                preempted = bool(self.dist.broadcast_obj(
+                    preempted if self._leader else None))
+                if preempted:
+                    self.preempt_event.set()
+            if preempted:
+                # checkpoint-and-yield: persist the current params as the
+                # newest epoch checkpoint (resume restarts the next epoch);
+                # the final export belongs to a COMPLETED job only
+                if self.history.train_loss:
+                    self._save_checkpoint(len(self.history.train_loss) - 1)
+            elif opts.save_model and self.history.train_loss:
                 if opts.sharded_checkpoints:
                     # gather-free FINAL export: the rationale for sharded
                     # checkpoints ("no host ever materializes a full leaf")

@@ -41,14 +41,39 @@ class JobJournal:
     def clear(self, job_id: str) -> None:
         self._path(job_id).unlink(missing_ok=True)
 
-    def pending(self) -> List[dict]:
-        """Journaled jobs from a previous life (the crash-recovery set)."""
+    def pending(self, quarantine: bool = True) -> List[dict]:
+        """Journaled jobs from a previous life (the crash-recovery set).
+
+        A corrupt entry is QUARANTINED — renamed to ``<name>.corrupt`` with a
+        warning — instead of silently re-parsed and skipped on every boot
+        forever: the operator sees one actionable warning, later boots stop
+        paying the parse, and the evidence survives for inspection.
+        ``quarantine=False`` makes the scan strictly read-only (corrupt
+        entries are skipped with a debug log) — for listing paths like
+        ``GET /jobs``, where a read must not mutate the journal dir."""
         out = []
         for p in sorted(self.dir.glob("*.json")):
             try:
                 out.append(json.loads(p.read_text()))
             except ValueError:
-                log.warning("journal entry %s is corrupt; skipping", p.name)
+                if not quarantine:
+                    log.debug("journal entry %s is corrupt; skipping "
+                              "(quarantined at the next recovery scan)",
+                              p.name)
+                    continue
+                quarantined = p.with_suffix(p.suffix + ".corrupt")
+                try:
+                    p.replace(quarantined)
+                    log.warning(
+                        "journal entry %s is corrupt; quarantined to %s "
+                        "(the job is NOT recovered — resubmit it manually "
+                        "with --resume if its checkpoints exist)",
+                        p.name, quarantined.name)
+                except OSError:
+                    log.warning("journal entry %s is corrupt and could not "
+                                "be quarantined; skipping", p.name)
+            except OSError:
+                log.warning("journal entry %s is unreadable; skipping", p.name)
         return out
 
     def recover_into(self, scheduler) -> int:

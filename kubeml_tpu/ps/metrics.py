@@ -184,6 +184,10 @@ SERVING_GAUGES = {
         "tokens_per_second", "Sustained decode rate (10s window)"),
     "kubeml_serving_queue_depth": ("queue_depth",
                                    "Rows waiting for a decode slot"),
+    "kubeml_serving_overload_per_second": (
+        "overload_per_second",
+        "Sustained 429 admission-refusal rate (10s window; a preemption "
+        "controller overload signal)"),
     "kubeml_serving_queue_limit": (
         "queue_limit", "Admission limit on queued rows (0 = unbounded)"),
     "kubeml_serving_slots_busy": ("slots_busy", "Occupied decode slots"),
@@ -221,6 +225,15 @@ SERVING_GAUGES = {
 }
 
 
+PREEMPTIONS = "kubeml_preemptions_total"
+YIELD_SECONDS = "kubeml_preempt_yield_seconds"
+QUEUE_DEPTH = "kubeml_scheduler_queue_depth"
+
+# distinct preemption reasons kept on the exposition (an unbounded reason
+# label would be a cardinality leak; extra reasons fold into "other")
+MAX_PREEMPT_REASONS = 16
+
+
 class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
@@ -230,6 +243,12 @@ class MetricsRegistry:
         # eviction past MAX_HISTOGRAM_JOBS
         self._hists: Dict[Tuple[str, str], Histogram] = {}
         self._running: Dict[str, int] = {"train": 0, "inference": 0}
+        # multi-tenant preemption: {reason: count} + yield-latency histogram
+        # (preempt request -> slot freed); per-priority queue depths come
+        # from a scheduler-provided source at render time
+        self._preemptions: Dict[str, int] = {}
+        self._yield_hist = Histogram()
+        self._queue_source = None
         # per-job high-water mark of applied dataplane delta batches
         # (MetricUpdate.dataplane seqs): a redelivered batch — the runner
         # re-sends until a client-observed ack — must fold into the
@@ -241,6 +260,28 @@ class MetricsRegistry:
 
     def set_serving_source(self, source) -> None:
         self._serving_source = source
+
+    def set_queue_source(self, source) -> None:
+        """() -> {priority: queued count} (scheduler.queue.depths); read at
+        render time so the exposition never holds the queue lock long."""
+        self._queue_source = source
+
+    def preemption(self, reason: str) -> None:
+        """Count one preemption decision (kubeml_preemptions_total{reason})."""
+        with self._lock:
+            if reason not in self._preemptions:
+                # reserve a slot for "other" INSIDE the budget: folding must
+                # not itself mint a 17th series
+                limit = (MAX_PREEMPT_REASONS if "other" in self._preemptions
+                         else MAX_PREEMPT_REASONS - 1)
+                if len(self._preemptions) >= limit:
+                    reason = "other"
+            self._preemptions[reason] = self._preemptions.get(reason, 0) + 1
+
+    def observe_yield(self, seconds: float) -> None:
+        """Yield latency: preempt request -> the job's slot freed."""
+        with self._lock:
+            self._yield_hist.observe(seconds)
 
     def update(self, u: MetricUpdate) -> None:
         """Per-epoch push from a job (reference: metrics.go:90-98)."""
@@ -350,7 +391,35 @@ class MetricsRegistry:
             for kind, n in sorted(self._running.items()):
                 lines.append(
                     f'{RUNNING}{{type="{escape_label_value(kind)}"}} {n}')
+            # multi-tenant preemption series (scheduler/preemption.py)
+            lines.append(f"# HELP {PREEMPTIONS} Training jobs preempted "
+                         f"(checkpoint-and-yield), by reason")
+            lines.append(f"# TYPE {PREEMPTIONS} counter")
+            for reason, n in sorted(self._preemptions.items()):
+                lines.append(f'{PREEMPTIONS}{{reason='
+                             f'"{escape_label_value(reason)}"}} {n}')
+            lines.append(f"# HELP {YIELD_SECONDS} Preemption yield latency "
+                         f"(preempt request until the job's slot freed)")
+            lines.append(f"# TYPE {YIELD_SECONDS} histogram")
+            # rendered even at zero observations: the exported metric set
+            # (and the dashboard's quantile query) must not depend on a
+            # preemption having happened yet
+            lines.extend(self._yield_hist.render(YIELD_SECONDS))
             source = self._serving_source
+            queue_source = self._queue_source
+        # per-priority scheduler queue gauges OUTSIDE the lock (the source
+        # snapshots the queue under its own lock and must not nest under ours)
+        lines.append(f"# HELP {QUEUE_DEPTH} Queued train tasks per priority "
+                     f"class")
+        lines.append(f"# TYPE {QUEUE_DEPTH} gauge")
+        if queue_source is not None:
+            try:
+                depths = queue_source()
+            except Exception:
+                depths = {}
+            for prio, n in sorted(depths.items()):
+                lines.append(f'{QUEUE_DEPTH}{{priority='
+                             f'"{escape_label_value(prio)}"}} {n}')
         # serving telemetry OUTSIDE the lock: the source snapshots each
         # decoder under its own lock and must not nest under ours. HELP/TYPE
         # headers render even with no source/decoders — the exported metric

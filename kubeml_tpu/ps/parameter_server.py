@@ -77,6 +77,9 @@ class _JobRecord:
     # dying) keeps its journal entry so the next supervised boot resubmits
     # it with resume=True — clearing it would turn crash recovery into a no-op
     keep_journal: bool = False
+    # wall time of the first preempt request (None = never preempted): the
+    # yield-latency clock, and the marker the grace watchdog checks
+    preempt_t0: Optional[float] = None
 
 
 class ParameterServer:
@@ -350,6 +353,17 @@ class ParameterServer:
         with self._lock:
             if self._jobs.get(job_id) is not record:
                 return False  # already finished, or the id belongs to a new job
+        if record.preempt_t0 is not None:
+            # a preempted runner dying is the expected end of a hard yield
+            # (or a crash mid-yield — equivalent: the atomic checkpoint and
+            # the kept journal entry make it fully resumable), not a failure
+            # to page on: PREEMPTED status routes it back into the requeue
+            # path instead of the error webhook
+            log.warning("preempted job %s terminated before a clean yield "
+                        "(%s); resuming from its newest checkpoint", job_id,
+                        error)
+            record.task.status = JobStateEnum.PREEMPTED
+            return self._finish(job_id, expect=record)
         record.task.status = JobStateEnum.FAILED
         self._ensure_failure_history(job_id, record.task.parameters, error)
         return self._finish(job_id, expect=record)
@@ -475,7 +489,12 @@ class ParameterServer:
             "finished": JobStateEnum.FINISHED,
             "stopped": JobStateEnum.STOPPED,
             "failed": JobStateEnum.FAILED,
+            "preempted": JobStateEnum.PREEMPTED,
         }.get(status, JobStateEnum.FINISHED if not error else JobStateEnum.FAILED)
+        if record.task.status == JobStateEnum.PREEMPTED:
+            # the runner may have been preempted directly (its /preempt route
+            # is reachable without the PS) — the journal must survive anyway
+            record.keep_journal = True
         self._finish(job_id)
         self._reap(record)
 
@@ -621,9 +640,17 @@ class ParameterServer:
     def _run_job(self, task: TrainTask, job: TrainJob, record=None) -> None:
         try:
             job.train()
-            task.status = (
-                JobStateEnum.STOPPED if job.stop_event.is_set() else JobStateEnum.FINISHED
-            )
+            if getattr(job, "preempted", False):
+                # checkpoint-and-yield: the job parked itself with a resume
+                # checkpoint; the journal entry stays so it is requeued
+                task.status = JobStateEnum.PREEMPTED
+                if record is not None:
+                    record.keep_journal = True
+            else:
+                task.status = (
+                    JobStateEnum.STOPPED if job.stop_event.is_set()
+                    else JobStateEnum.FINISHED
+                )
             if record is not None and task.status == JobStateEnum.FINISHED:
                 # a job that completed during shutdown must not be resubmitted
                 # on the next boot, even if the shutdown path flagged it
@@ -676,11 +703,25 @@ class ParameterServer:
                 log.exception("clearing journal for %s failed (non-fatal)", job_id)
         self.metrics.clear(job_id)
         self.metrics.task_finished("train")
+        if record.preempt_t0 is not None:
+            # yield latency: preempt request -> slot freed (covers the round
+            # drain, the yield checkpoint, and — on escalation — the grace)
+            self.metrics.observe_yield(time.time() - record.preempt_t0)
         if self.scheduler is not None:
             try:
                 self.scheduler.finish_job(job_id)
             except Exception:
                 log.exception("notifying scheduler of %s finish failed", job_id)
+            if record.task.status == JobStateEnum.PREEMPTED:
+                # hand the parked job back: the preemption controller holds
+                # it until pressure clears (or, without one, it requeues
+                # immediately — behind whatever outranked it)
+                try:
+                    self.scheduler.job_preempted(record.task)
+                except Exception:
+                    log.exception("requeue of preempted job %s failed "
+                                  "(journal entry remains for the next boot)",
+                                  job_id)
         if record.update_box is not None:
             # unblock a job thread stuck waiting for a scheduler answer
             record.update_box.event.set()
@@ -787,12 +828,180 @@ class ParameterServer:
         with self._lock:
             return [r.task for r in self._jobs.values()]
 
+    def _resume_epoch(self, job_id: str) -> int:
+        """The epoch a resumed job would restart at, from checkpoint METADATA
+        only (mirrors engine/resume.select_resume_checkpoint's decision
+        without reading any weight arrays — this is a listing, not a load)."""
+        try:
+            tags = self._ckpt_store.tags(job_id)
+            last = self._ckpt_store.latest_epoch(job_id)
+            start = 0 if last is None else last + 1
+            if FINAL_TAG in tags:
+                start = max(start, int(
+                    self._ckpt_store.read_meta(job_id, FINAL_TAG).get("epoch", 0)))
+            return start
+        except Exception:
+            return 0
+
+    def jobs_snapshot(self, include_journal: bool = True) -> List[dict]:
+        """The PS half of the `kubeml jobs` operator view: live records
+        (running/starting/yielding) plus journaled-but-not-live jobs — the
+        preempted/interrupted set awaiting requeue — with the epoch resume
+        would restart at. ``include_journal=False`` skips the journal scan
+        and checkpoint-metadata reads: the preemption controller's victim
+        picker polls every tick and only needs the live records."""
+        out = []
+        with self._lock:
+            records = list(self._jobs.items())
+        live = set()
+        for jid, r in records:
+            live.add(jid)
+            opts = r.task.parameters.options
+            out.append({
+                "job_id": jid,
+                "status": r.task.status,
+                "priority": int(getattr(opts, "priority", 0)),
+                "tenant": str(getattr(opts, "tenant", "")),
+                "function": r.task.parameters.function_name,
+                "parallelism": r.task.state.parallelism,
+                "preempting": r.preempt_t0 is not None,
+            })
+        if not include_journal:
+            return out
+        try:
+            # read-only scan: an operator listing must not rename journal
+            # files (quarantine belongs to the boot-time recovery path)
+            pending = self._journal.pending(quarantine=False)
+        except Exception:
+            pending = []
+        for entry in pending:
+            jid = entry.get("job_id", "")
+            if not jid or jid in live:
+                continue
+            req = entry.get("request", {}) or {}
+            opts = req.get("options", {}) or {}
+            out.append({
+                "job_id": jid,
+                "status": JobStateEnum.PREEMPTED,
+                "priority": int(opts.get("priority", 0) or 0),
+                "tenant": str(opts.get("tenant", "") or ""),
+                "function": req.get("function_name", ""),
+                "resume_epoch": self._resume_epoch(jid),
+            })
+        return out
+
+    def serving_telemetry(self) -> dict:
+        """{model_id: telemetry snapshot} across the resident decoders — the
+        public read the preemption controller polls for overload signals
+        (queue depth, 429 counters, request p99)."""
+        return self._serving_telemetry()
+
     def get_task(self, job_id: str) -> TrainTask:
         with self._lock:
             record = self._jobs.get(job_id)
         if record is None:
             raise JobNotFoundError(job_id)
         return record.task
+
+    def preempt_task(self, job_id: str, reason: str = "operator",
+                     grace: Optional[float] = None) -> None:
+        """`/preempt/{jobId}` — checkpoint-and-yield (multi-tenant
+        preemption): flag the job to exit at its next round boundary with a
+        resume checkpoint and the ``preempted`` terminal status. The journal
+        entry is kept however the yield ends, so the job is always
+        resumable. A grace watchdog escalates to a hard kill after
+        ``grace`` seconds (KUBEML_PREEMPT_GRACE): safe because checkpoint
+        publish is atomic — a SIGKILL mid-yield leaves either the previous
+        or the new checkpoint, never a torn one."""
+        with self._lock:
+            record = self._jobs.get(job_id)
+        if record is None:
+            raise JobNotFoundError(job_id)
+        if grace is None:
+            grace = self.cfg.preempt_grace
+        first = record.preempt_t0 is None
+        if first:
+            record.preempt_t0 = time.time()
+        # resume state must survive whatever happens next — set BEFORE any
+        # signal so even an instant crash keeps the journal entry
+        record.keep_journal = True
+        try:
+            if record.url is not None:
+                from ..utils import traced_http as requests
+
+                try:
+                    r = requests.post(f"{record.url}/preempt",
+                                      timeout=requests.timeouts(10),
+                                      idempotency_key=True)
+                except requests.RequestException as e:
+                    raise KubeMLError(
+                        f"job {job_id} runner unreachable: {e}", 502)
+                if r.status_code >= 400:
+                    from ..api.errors import error_from_envelope
+
+                    raise error_from_envelope(r.content, r.status_code)
+            elif record.job is None:
+                raise KubeMLError(f"job {job_id} is still starting", 409)
+            else:
+                record.job.preempt()
+                if record.update_box is not None:
+                    # unblock a job thread waiting on the scheduler's
+                    # epoch-end answer — the yield must not wait out
+                    # KUBEML_UPDATE_TIMEOUT
+                    record.update_box.event.set()
+        except Exception:
+            # the signal never reached the job: roll the yield clock back so
+            # a retry is again "first" (starts the watchdog, counts the
+            # metric) and the victim picker does not skip the job as
+            # already-yielding forever. keep_journal deliberately stays set
+            # — extra resumability is safe, a lost journal entry is not.
+            if first:
+                record.preempt_t0 = None
+            raise
+        if first:
+            self.metrics.preemption(reason)
+            log.info("preempting job %s (%s; grace %.0fs)", job_id, reason,
+                     grace)
+            threading.Thread(
+                target=self._preempt_grace_watch, args=(job_id, record, grace),
+                name=f"preempt-grace-{job_id}", daemon=True).start()
+
+    def _preempt_grace_watch(self, job_id: str, record: _JobRecord,
+                             grace: float) -> None:
+        """Hard-kill escalation: a preempted job that has not freed its slot
+        within the grace period is killed (standalone: SIGKILL the runner;
+        threaded: the thread is abandoned like a wedged job). The teardown
+        carries PREEMPTED status — the journal entry and the newest atomic
+        checkpoint make the job fully resumable, so escalation converts an
+        unbounded yield into a bounded one instead of losing the work."""
+        deadline = record.preempt_t0 + max(0.0, grace)
+        while time.time() < deadline:
+            with self._lock:
+                if self._jobs.get(job_id) is not record:
+                    return  # yielded (or torn down) in time
+            time.sleep(min(0.2, max(0.01, deadline - time.time())))
+        with self._lock:
+            if self._jobs.get(job_id) is not record:
+                return
+        log.warning("job %s did not yield within the %.0fs preempt grace; "
+                    "hard-killing (checkpoint publish is atomic — the job "
+                    "resumes from its newest checkpoint)", job_id, grace)
+        self.metrics.preemption("hard-kill")
+        record.task.status = JobStateEnum.PREEMPTED
+        if record.proc is not None:
+            try:
+                record.proc.kill()
+            except Exception:
+                pass
+            self._reap(record)
+        else:
+            try:
+                record.job.stop()  # cooperative; a wedged thread ignores it
+            except Exception:
+                pass
+        # expect-guarded: a yield that races the deadline must not tear down
+        # a resubmitted job that reused the id
+        self._finish(job_id, expect=record)
 
     def stop_task(self, job_id: str) -> None:
         """`/stop/{jobId}` -> job stop flag (reference train/api.go:129-134)."""

@@ -12,7 +12,7 @@ from typing import Optional
 
 from ..api.config import Config, get_config
 from ..api.errors import error_from_envelope
-from ..api.types import TrainTask
+from ..api.types import TrainTask, parse_grace_seconds
 from ..utils import traced_http as requests  # traceparent-stamped requests
 from ..utils.httpd import Request, Response, Router, Service
 from .parameter_server import ParameterServer
@@ -27,6 +27,8 @@ class PSAPI:
         router.route("POST", "/update/{jobId}", self._update)
         router.route("POST", "/infer", self._infer)
         router.route("DELETE", "/stop/{jobId}", self._stop)
+        router.route("POST", "/preempt/{jobId}", self._preempt)
+        router.route("GET", "/jobs", self._jobs)
         router.route("GET", "/tasks", self._tasks)
         router.route("GET", "/metrics", self._metrics)
         # job-runner callbacks (reference routes /metrics/{jobId} and
@@ -55,6 +57,23 @@ class PSAPI:
     def _stop(self, req: Request):
         self.ps.stop_task(req.params["jobId"])
         return {}
+
+    def _preempt(self, req: Request):
+        """Checkpoint-and-yield a running job (multi-tenant preemption):
+        optional JSON body {"reason": ..., "grace": seconds}."""
+        body = req.json() or {}
+        self.ps.preempt_task(
+            req.params["jobId"],
+            reason=str(body.get("reason") or "operator"),
+            grace=parse_grace_seconds(body.get("grace")),
+        )
+        return {"status": "preempting"}
+
+    def _jobs(self, req: Request):
+        # ?journal=0 skips the journal scan (the preemption controller's
+        # per-tick victim poll needs live records only)
+        return self.ps.jobs_snapshot(
+            include_journal=req.arg("journal", "1") != "0")
 
     def _tasks(self, req: Request):
         return [t.to_dict() for t in self.ps.list_tasks()]
@@ -153,6 +172,20 @@ class PSClient:
     def stop_task(self, job_id: str) -> None:
         _check(requests.delete(f"{self.url}/stop/{job_id}",
                                timeout=self._timeout()))
+
+    def preempt_task(self, job_id: str, reason: str = "operator",
+                     grace: Optional[float] = None) -> None:
+        body: dict = {"reason": reason}
+        if grace is not None:
+            body["grace"] = grace
+        _check(requests.post(f"{self.url}/preempt/{job_id}", json=body,
+                             timeout=self._timeout(),
+                             idempotency_key=True))
+
+    def jobs_snapshot(self, include_journal: bool = True) -> list:
+        suffix = "" if include_journal else "?journal=0"
+        return _check(requests.get(f"{self.url}/jobs{suffix}",
+                                   timeout=self._timeout()))
 
     def list_tasks(self):
         return [TrainTask.from_dict(d) for d in _check(

@@ -1,43 +1,139 @@
-"""Mutex-guarded FIFO of scheduler work items.
+"""Mutex-guarded priority queue of scheduler work items.
 
-Mirrors the reference's scheduler queue (reference: ml/pkg/scheduler/queue.go:15-83):
-a plain FIFO holding both brand-new train tasks and epoch-end re-evaluation
-requests from running jobs; the scheduler loop pops one at a time. Unlike the
-reference's 10ms poll loop, popping blocks on a condition variable so the loop
-wakes immediately when work arrives."""
+The reference's scheduler queue is a plain FIFO (reference:
+ml/pkg/scheduler/queue.go:15-83) holding both brand-new train tasks and
+epoch-end re-evaluation requests from running jobs; the scheduler loop pops
+one at a time. Unlike the reference's 10ms poll loop, popping blocks on a
+condition variable so the loop wakes immediately when work arrives.
+
+Multi-tenant extension: pop order is (priority class desc, tenant fair
+share, FIFO). Higher ``TrainOptions.priority`` pops first; within one class
+the tenant with the least accumulated device-seconds (:class:`TenantUsage`,
+charged by the scheduler from epoch-end reports) goes next — so a tenant
+that has been hogging the devices queues behind lighter tenants of the same
+class; within one tenant arrival order holds. A single class of one tenant
+degrades to exactly the reference FIFO.
+"""
 
 from __future__ import annotations
 
 import threading
-from collections import deque
-from typing import Optional
+from typing import Dict, List, Optional
 
 from ..api.types import TrainTask
 
 
-class TaskQueue:
+class TenantUsage:
+    """Accumulated device-seconds per tenant — the fair-share currency.
+
+    Charged by the scheduler on every epoch-end report (parallelism x epoch
+    seconds: what the tenant actually held, not what it asked for). Decay is
+    deliberate-ly absent: fair share here is lifetime-of-the-process, the
+    reference horizon for the all-in-one deployment; a restart forgives."""
+
     def __init__(self):
-        self._q: deque = deque()
+        self._seconds: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def charge(self, tenant: str, device_seconds: float) -> None:
+        if device_seconds <= 0:
+            return
+        with self._lock:
+            self._seconds[tenant] = self._seconds.get(tenant, 0.0) + float(
+                device_seconds)
+
+    def get(self, tenant: str) -> float:
+        with self._lock:
+            return self._seconds.get(tenant, 0.0)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._seconds)
+
+
+def task_priority(task: TrainTask) -> int:
+    try:
+        return int(task.parameters.options.priority)
+    except (AttributeError, TypeError, ValueError):
+        return 0
+
+
+def task_tenant(task: TrainTask) -> str:
+    try:
+        return str(task.parameters.options.tenant or "")
+    except AttributeError:
+        return ""
+
+
+class TaskQueue:
+    def __init__(self, usage: Optional[TenantUsage] = None):
+        # entries in arrival order: [(seq, task)]; selection scans — queue
+        # depths are human-scale (jobs, not requests), so O(n) pop beats a
+        # heap that cannot express the usage-dependent tenant tie-break
+        self._q: List[tuple] = []
+        self._seq = 0
+        self.usage = usage or TenantUsage()
         self._cond = threading.Condition()
 
     def push(self, task: TrainTask) -> None:
         with self._cond:
-            self._q.append(task)
+            self._q.append((self._seq, task))
+            self._seq += 1
             self._cond.notify()
 
+    def _select(self) -> int:
+        """Index of the entry to pop next (caller holds the lock):
+        highest priority class; within it the least-charged tenant; within
+        the tenant, arrival order."""
+        best = 0
+        best_key = None
+        for i, (seq, task) in enumerate(self._q):
+            key = (-task_priority(task),
+                   self.usage.get(task_tenant(task)),
+                   seq)
+            if best_key is None or key < best_key:
+                best, best_key = i, key
+        return best
+
     def pop(self, timeout: Optional[float] = None) -> Optional[TrainTask]:
-        """Pop the oldest item, blocking up to ``timeout`` seconds; None if empty."""
+        """Pop the next item by (priority, fair share, FIFO), blocking up to
+        ``timeout`` seconds; None if empty."""
         with self._cond:
             if not self._q:
                 self._cond.wait(timeout)
             if not self._q:
                 return None
-            return self._q.popleft()
+            return self._q.pop(self._select())[1]
 
     def job_ids(self) -> set:
         """Snapshot of the job ids currently queued (duplicate-submit guard)."""
         with self._cond:
-            return {t.job_id for t in self._q}
+            return {t.job_id for _, t in self._q}
+
+    def depths(self) -> Dict[int, int]:
+        """{priority class: queued count} — the per-priority queue gauges."""
+        out: Dict[int, int] = {}
+        with self._cond:
+            for _, t in self._q:
+                p = task_priority(t)
+                out[p] = out.get(p, 0) + 1
+        return out
+
+    def snapshot(self) -> List[dict]:
+        """Queued entries in pop order (the `kubeml jobs` listing)."""
+        with self._cond:
+            entries = list(self._q)
+        entries.sort(key=lambda e: (-task_priority(e[1]),
+                                    self.usage.get(task_tenant(e[1])),
+                                    e[0]))
+        return [{
+            "job_id": t.job_id,
+            "status": "queued",
+            "priority": task_priority(t),
+            "tenant": task_tenant(t),
+            "function": t.parameters.function_name,
+            "resume": bool(t.parameters.options.resume),
+        } for _, t in entries]
 
     def __len__(self) -> int:
         with self._cond:

@@ -29,6 +29,8 @@ class SchedulerAPI:
         router.route("POST", "/infer", self._infer)
         router.route("POST", "/generate", self._generate)
         router.route("POST", "/job", self._job)
+        router.route("POST", "/preempted", self._preempted)
+        router.route("GET", "/jobs", self._jobs)
         router.route("DELETE", "/finish/{taskId}", self._finish)
         self.service = Service(router, self.cfg.host, self.cfg.scheduler_port)
 
@@ -52,6 +54,15 @@ class SchedulerAPI:
     def _job(self, req: Request):
         self.scheduler.update_job(TrainTask.parse_request(req.json() or {}))
         return {}
+
+    def _preempted(self, req: Request):
+        """A preempted job's requeue hand-off from a remote PS (the
+        in-process path calls scheduler.job_preempted directly)."""
+        self.scheduler.job_preempted(TrainTask.parse_request(req.json() or {}))
+        return {}
+
+    def _jobs(self, req: Request):
+        return self.scheduler.jobs_snapshot()
 
     def _finish(self, req: Request):
         self.scheduler.finish_job(req.params["taskId"])
@@ -139,6 +150,15 @@ class SchedulerClient:
         _check(requests.post(f"{self.url}/job", json=task.to_dict(),
                              timeout=self._timeout(),
                              idempotency_key=True))
+
+    def job_preempted(self, task: TrainTask) -> None:
+        _check(requests.post(f"{self.url}/preempted", json=task.to_dict(),
+                             timeout=self._timeout(),
+                             idempotency_key=True))
+
+    def jobs_snapshot(self) -> list:
+        return _check(requests.get(f"{self.url}/jobs",
+                                   timeout=self._timeout()))
 
     def finish_job(self, job_id: str) -> None:
         _check(requests.delete(f"{self.url}/finish/{job_id}",

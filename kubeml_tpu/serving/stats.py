@@ -62,6 +62,10 @@ class DecoderStats:
         self._lat: deque = deque(maxlen=LATENCY_RING)        # (total_s,)
         self._first: deque = deque(maxlen=LATENCY_RING)      # first-token s
         self._emits: deque = deque()  # (t, n_tokens) for the rate window
+        # 429 timestamps for the windowed overload rate (the preemption
+        # controller's burst signal: a cumulative counter alone cannot
+        # distinguish "bursting now" from "bursted an hour ago")
+        self._overload_ts: deque = deque()
         # cumulative bucket histograms (process lifetime, not windowed):
         # rendered as kubeml_serving_*_seconds_bucket on the PS /metrics
         self._hist_first = Histogram()
@@ -136,8 +140,13 @@ class DecoderStats:
             self.requests_canceled += 1
 
     def overloaded(self) -> None:
+        now = time.monotonic()
         with self._lock:
             self.requests_overload += 1
+            self._overload_ts.append(now)
+            cutoff = now - 2 * RATE_WINDOW_S
+            while self._overload_ts and self._overload_ts[0] < cutoff:
+                self._overload_ts.popleft()
 
     def shed(self) -> None:
         with self._lock:
@@ -152,6 +161,13 @@ class DecoderStats:
             self.requests_failed += rows
 
     # --- render-time reads ---
+
+    def overload_per_second(self) -> float:
+        """Sustained 429 rate over the ~10s window (0 when quiet)."""
+        now = time.monotonic()
+        with self._lock:
+            hits = [t for t in self._overload_ts if t >= now - RATE_WINDOW_S]
+        return len(hits) / RATE_WINDOW_S
 
     def tokens_per_second(self) -> float:
         now = time.monotonic()
@@ -209,6 +225,7 @@ class DecoderStats:
         if hist:
             out["hist"] = hist
         out["tokens_per_second"] = self.tokens_per_second()
+        out["overload_per_second"] = self.overload_per_second()
         for q, name in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99"),
                         (1.0, "max")):
             v = self._quantile(lat, q)

@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# Multi-tenant preemption demo — the colocation flagship scenario:
+# a latency-critical serving burst and a preemptible training job share one
+# cluster; the preemption controller watches the serving overload signals
+# (queue depth, 429 rate, p99), checkpoint-and-yields the training job,
+# serving p99 recovers on the reclaimed capacity, and once the burst clears
+# the job is requeued with resume=True and reaches final-loss parity with an
+# uninterrupted run. A machine-readable row appends to
+# results/preempt_demo.jsonl.
+#
+#   scripts/preempt_demo.sh [--full]     (default: quick sizing)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p results
+
+QUICK=1
+if [[ "${1:-}" == "--full" ]]; then QUICK=0; fi
+
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+KUBEML_PREEMPT_MONITOR=1 \
+KUBEML_PREEMPT_INTERVAL="${KUBEML_PREEMPT_INTERVAL:-0.2}" \
+KUBEML_PREEMPT_QUEUE_DEPTH="${KUBEML_PREEMPT_QUEUE_DEPTH:-3}" \
+KUBEML_PREEMPT_OVERLOAD_RATE="${KUBEML_PREEMPT_OVERLOAD_RATE:-1.0}" \
+KUBEML_PREEMPT_SUSTAIN="${KUBEML_PREEMPT_SUSTAIN:-2}" \
+KUBEML_PREEMPT_RESUME_SUSTAIN="${KUBEML_PREEMPT_RESUME_SUSTAIN:-5}" \
+KUBEML_PREEMPT_COOLDOWN="${KUBEML_PREEMPT_COOLDOWN:-10}" \
+KUBEML_PREEMPT_GRACE="${KUBEML_PREEMPT_GRACE:-60}" \
+KUBEML_SERVING_SLOTS=2 \
+KUBEML_SERVING_QUEUE_LIMIT=6 \
+KUBEML_DATA_ROOT="${KUBEML_DATA_ROOT:-$(mktemp -d)/kubeml}" \
+python - "$QUICK" <<'EOF'
+import json, sys
+
+quick = sys.argv[1] == "1"
+
+from kubeml_tpu.benchmarks.scenarios import run_colocation
+
+row = run_colocation(quick=quick)
+
+# --- the acceptance invariants, asserted on the recorded row ---
+assert row["metrics"]["preemptions"] >= 1, "no preemption happened"
+assert row["metrics"]["preemptions_total_visible"], \
+    "kubeml_preemptions_total missing from /metrics"
+assert row["metrics"]["yield_histogram_visible"], \
+    "kubeml_preempt_yield_seconds missing from /metrics"
+assert row["metrics"]["queue_gauge_visible"], \
+    "kubeml_scheduler_queue_depth missing from /metrics"
+assert row["resumed"]["epochs"] == row["epochs"], \
+    f"resumed run incomplete: {row['resumed']}"
+assert row["resumed"]["loss_parity"], \
+    (f"final-loss parity failed: delta {row['resumed']['loss_delta_vs_baseline']} "
+     f"> tol {row['resumed']['tolerance']}")
+if not row["serving"]["p99_recovered"]:
+    print("warning: serving p99 did not improve after reclaim "
+          f"(during={row['serving']['p99_during_s']}s, "
+          f"after={row['serving']['p99_after_s']}s) — noisy host?",
+          file=sys.stderr)
+
+with open("results/preempt_demo.jsonl", "a") as f:
+    f.write(json.dumps(row) + "\n")
+print(json.dumps(row, indent=2))
+print("\npreempt demo PASSED")
+EOF

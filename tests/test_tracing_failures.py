@@ -244,6 +244,50 @@ def test_health_multiple_dead():
     assert h.suggest_parallelism(1) == 1  # floor
 
 
+def test_health_all_dead_round_floors_at_one():
+    """Re-mesh edge: EVERY worker persistently failed still leaves a 1-wide
+    mesh suggestion (the collective cannot shrink to zero shards); with the
+    injector's keep_one_alive the all-dead mask never reaches health in the
+    first place — the guaranteed survivor resets its own count."""
+    h = WorkerHealth(threshold=1)
+    assert sorted(h.update(np.zeros(4))) == [0, 1, 2, 3]
+    assert h.persistent == {0, 1, 2, 3}
+    assert h.suggest_parallelism(4) == 1  # floor 1, never 0
+    # the keep_one_alive injector cannot produce that mask: one worker always
+    # survives, so at most n-1 cross the threshold per round
+    inj = FailureInjector(prob=1.0, seed=3, keep_one_alive=True)
+    h2 = WorkerHealth(threshold=1)
+    h2.update(inj.mask(4))
+    assert len(h2.persistent) == 3
+    assert h2.suggest_parallelism(4) == 1  # 4 - 3, already the floor
+
+
+def test_health_dead_beyond_current_parallelism_does_not_shrink():
+    """parallelism_after_death counts only persistently dead workers BELOW
+    the current width: after an elastic shrink, a stale higher index must
+    not shrink the mesh again."""
+    h = WorkerHealth(threshold=1)
+    h.update(np.array([1, 1, 1, 0]))  # worker 3 persistently dead
+    assert h.suggest_parallelism(4) == 3
+    # mesh already shrunk to 2: the dead index 3 is out of range
+    assert h.suggest_parallelism(2) == 2
+
+
+def test_health_reset_clears_consecutive_counts_after_shrink():
+    """Worker indices renumber on a re-mesh, so consecutive-failure counts
+    must NOT transfer: a worker one round short of the threshold before the
+    shrink starts from zero after reset()."""
+    h = WorkerHealth(threshold=3)
+    h.update(np.array([1, 0]))
+    h.update(np.array([1, 0]))  # worker 1 at 2 of 3
+    assert h.persistent == set()
+    h.reset()  # the job re-meshed; indices renumbered
+    assert h.update(np.array([1, 0])) == []  # count restarted at 1, not 3
+    assert h.persistent == set()
+    h.update(np.array([1, 0]))
+    assert h.update(np.array([1, 0])) == [1]  # three POST-reset rounds trip it
+
+
 # --- TrainJob integration ---
 
 
