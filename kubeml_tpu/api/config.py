@@ -156,6 +156,57 @@ class Config:
         default_factory=lambda: float(os.environ.get("KUBEML_PREEMPT_COOLDOWN", "30"))
     )
 
+    # --- serving SLO observability (utils/timeseries.py + ps/slo.py) ---
+    # embedded time-series store: the PS samples its metrics registry into
+    # bounded per-series rings (served at GET /metrics/history; the SLO
+    # engine and `kubeml top` read it). KUBEML_TSDB=0 disables sampling.
+    tsdb_enable: bool = field(default_factory=lambda: _env_bool("KUBEML_TSDB", True))
+    # seconds between registry samples
+    tsdb_interval: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_TSDB_INTERVAL", "1.0"))
+    )
+    # samples kept per series (600 x 1s = ~10 min of history)
+    tsdb_samples: int = field(
+        default_factory=lambda: _env_int("KUBEML_TSDB_SAMPLES", 600))
+    # distinct series kept (oldest-evicted past the cap)
+    tsdb_series: int = field(
+        default_factory=lambda: _env_int("KUBEML_TSDB_SERIES", 1024))
+    # declarative SLOs: semicolon-separated objectives `[name:]signal<=target`
+    # (or >=). Signals: availability, overload_rate, error_rate, ttft_p99,
+    # request_p99, queue_depth. Burn threshold defaults to 1.0; append @N to
+    # override (e.g. "availability>=0.99@6"). Empty string disables the
+    # engine entirely.
+    slo_spec: str = field(
+        default_factory=lambda: os.environ.get(
+            "KUBEML_SLOS",
+            "availability>=0.99;overload_rate<=5.0;ttft_p99<=2.5"))
+    # multi-window burn rates (Google SRE Workbook shape): the fast window
+    # catches "burning now", the slow window proves it is sustained — an
+    # alert needs BOTH above the objective's burn threshold
+    slo_fast_window: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_SLO_FAST_WINDOW", "60"))
+    )
+    slo_slow_window: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_SLO_SLOW_WINDOW", "300"))
+    )
+    # alert state machine hysteresis: seconds the burn condition must hold
+    # before pending escalates to firing, and seconds it must stay clear
+    # before firing resolves
+    slo_for: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_SLO_FOR", "5"))
+    )
+    slo_resolve_for: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_SLO_RESOLVE_FOR", "15"))
+    )
+    # `kubeml top` refresh interval and the window its rates/quantiles are
+    # computed over
+    top_interval: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_TOP_INTERVAL", "2.0"))
+    )
+    top_window: float = field(
+        default_factory=lambda: float(os.environ.get("KUBEML_TOP_WINDOW", "30"))
+    )
+
     # --- function execution guardrails (reference cmd/function.go:234-262:
     # per-function concurrency 50, execution timeout 1000s) ---
     # seconds a user-code call (function load, traced user module, a job

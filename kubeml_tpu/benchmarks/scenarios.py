@@ -782,6 +782,268 @@ def run_colocation(config: Optional[Config] = None, quick: bool = True,
     return row
 
 
+def run_slo_overload(config: Optional[Config] = None,
+                     quick: bool = True) -> dict:
+    """The serving SLO observability proof (PR 11): drive a live standalone
+    cluster through an induced overload — a client burst past
+    ``KUBEML_SERVING_QUEUE_LIMIT`` — and record the whole chain:
+
+    * per-request lifecycle histograms + serving spans (``kubeml trace``
+      works for a serving request id);
+    * occupancy/dead-step/goodput counters on /metrics that sum
+      consistently with the request-level token counts;
+    * ``GET /metrics/history`` returning windowed rates from the embedded
+      time-series store;
+    * at least one SLO alert transitioning pending -> firing -> resolved,
+      the firing delivered through the errorhook webhook (captured by a
+      local sink) with the flight-recorder tail attached.
+
+    The caller (``scripts/slo_demo.sh``) sets the env knobs — tight SLO
+    windows, a small queue limit, KUBEML_TRACE — before the Config is
+    built; returns the machine-readable row appended to
+    ``results/slo_demo.jsonl``."""
+    import http.server
+    import os
+    import threading
+
+    import flax.linen as nn
+    import jax
+
+    from ..api.config import get_config
+    from ..api.errors import KubeMLError
+    from ..api.types import GenerateRequest
+    from ..cluster import LocalCluster
+    from ..models.gpt import CausalTransformer
+    from ..storage.checkpoint import FINAL_TAG, CheckpointStore
+    from ..utils import traced_http
+
+    cfg = config or get_config()
+    cfg.ensure_dirs()
+    rng = np.random.default_rng(0)
+    row: Dict = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                 "scenario": "slo-overload", "quick": bool(quick)}
+
+    # --- local webhook sink: captures the SLO alert payloads ---
+    payloads: List[dict] = []
+
+    class _Sink(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                payloads.append(json.loads(self.rfile.read(n)))
+            except Exception:
+                pass
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    sink = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Sink)
+    sink_thread = threading.Thread(target=sink.serve_forever, daemon=True)
+    sink_thread.start()
+    prior_webhook = os.environ.get("KUBEML_ERROR_WEBHOOK")
+    os.environ["KUBEML_ERROR_WEBHOOK"] = \
+        f"http://127.0.0.1:{sink.server_address[1]}/alert"
+
+    def wait_for(pred, timeout, what):
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            if pred():
+                return True
+            time.sleep(0.2)
+        raise RuntimeError(f"timed out waiting for {what}")
+
+    try:
+        with LocalCluster(config=cfg) as cluster:
+            from ..functions.registry import FunctionRegistry
+
+            if not cluster.registry.exists("slo-serve"):
+                FunctionRegistry(config=cfg).create("slo-serve",
+                                                    _COLOC_SERVE_FN)
+            # a servable "finished" causal LM (random init exported final)
+            module = CausalTransformer(vocab_size=101, max_len=64,
+                                       embed_dim=64, depth=2, num_heads=4)
+            prompt = np.asarray(rng.integers(1, 101, size=(1, 8)), np.int32)
+            variables = jax.tree.map(np.asarray, nn.meta.unbox(
+                module.init(jax.random.PRNGKey(0), prompt)))
+            CheckpointStore(config=cfg).save(
+                "sloserve", variables, epoch=1, tag=FINAL_TAG,
+                meta={"request": {"function_name": "slo-serve",
+                                  "model_type": "slo-serve"}})
+            # warm the decoder: the cold XLA compile must not sit inside
+            # the burst's latency measurements
+            warm = cluster.scheduler.generate(GenerateRequest(
+                model_id="sloserve", prompts=prompt.tolist(),
+                max_new_tokens=4))
+            row["serving_request_id"] = warm.get("request_id", "")
+
+            # --- phase A: calm traffic earns availability budget ---
+            calm_tokens = 0
+            for _ in range(6):
+                r = cluster.scheduler.generate(GenerateRequest(
+                    model_id="sloserve", prompts=prompt.tolist(),
+                    max_new_tokens=8))
+                calm_tokens += sum(r["lengths"])
+            slo0 = cluster.ps.slo_status()
+            assert all(o["state"] == "inactive"
+                       for o in slo0["objectives"]), "calm phase not calm"
+
+            # --- phase B: burst past the queue limit -> 429s -> burn ---
+            stop_burst = threading.Event()
+            burst_tokens = [0]
+            overloads_seen = [0]
+            tok_lock = threading.Lock()
+
+            def burst_worker():
+                while not stop_burst.is_set():
+                    try:
+                        r = cluster.scheduler.generate(GenerateRequest(
+                            model_id="sloserve", prompts=prompt.tolist(),
+                            max_new_tokens=24))
+                        with tok_lock:
+                            burst_tokens[0] += sum(r["lengths"])
+                    except KubeMLError:
+                        with tok_lock:
+                            overloads_seen[0] += 1
+                        time.sleep(0.02)
+                    except Exception:
+                        time.sleep(0.02)
+
+            burst = [threading.Thread(target=burst_worker, daemon=True)
+                     for _ in range(10)]
+            t_burst = time.time()
+            for b in burst:
+                b.start()
+
+            def firing():
+                return any(o["state"] == "firing"
+                           for o in cluster.ps.slo_status()["objectives"])
+
+            wait_for(firing, 120, "an SLO alert to fire under the burst")
+            row["fire_latency_s"] = round(time.time() - t_burst, 2)
+
+            # --- phase C: recovery -> the alert must resolve ---
+            stop_burst.set()
+            for b in burst:
+                b.join(timeout=30)
+
+            def resolved():
+                status = cluster.ps.slo_status()
+                # calm traffic keeps earning budget while we wait
+                try:
+                    cluster.scheduler.generate(GenerateRequest(
+                        model_id="sloserve", prompts=prompt.tolist(),
+                        max_new_tokens=4))
+                except KubeMLError:
+                    pass
+                return (all(o["state"] == "inactive"
+                            for o in status["objectives"])
+                        and any(e["to"] == "resolved"
+                                for e in status["events"]))
+
+            wait_for(resolved, 180, "the SLO alert to resolve after calm")
+            status = cluster.ps.slo_status()
+            transitions = [(e["slo"], e["from"], e["to"])
+                           for e in status["events"]]
+            row["transitions"] = [
+                {"slo": s, "from": f, "to": t} for s, f, t in transitions]
+            fired = {s for s, _f, t in transitions if t == "firing"}
+            resolved_slos = {s for s, _f, t in transitions
+                             if t == "resolved"}
+            pend = {s for s, _f, t in transitions if t == "pending"}
+            assert fired & resolved_slos & pend, (
+                f"no objective went pending->firing->resolved: {transitions}")
+
+            # webhook evidence: the firing alert arrived with a flight tail
+            wait_for(lambda: any(
+                p.get("context", "").startswith("slo:") for p in payloads),
+                30, "the errorhook webhook delivery")
+            alert = next(p for p in payloads
+                         if p.get("context", "").startswith("slo:"))
+            row["alert_webhook"] = {
+                "context": alert.get("context"),
+                "burn_fast": alert.get("burn_fast"),
+                "flight_recorder_events": len(
+                    alert.get("flight_recorder", [])),
+            }
+
+            # --- the acceptance surfaces, scraped live over HTTP ---
+            base = cluster.ps_api.url
+            metrics = traced_http.get(f"{base}/metrics", timeout=10).text
+
+            def counter(name):
+                return sum(
+                    float(l.rsplit(" ", 1)[1]) for l in metrics.splitlines()
+                    if l.startswith(name + "{"))
+
+            occ = {k: counter(f"kubeml_serving_occupancy_{k}_steps_total")
+                   for k in ("live", "dead", "idle")}
+            slot_steps = counter("kubeml_serving_occupancy_slot_steps_total")
+            goodput = counter("kubeml_serving_goodput_tokens_total")
+            wasted = counter("kubeml_serving_wasted_tokens_total")
+            emitted = counter("kubeml_serving_tokens_total")
+            assert sum(occ.values()) == slot_steps, (
+                f"occupancy partition broken: {occ} != {slot_steps}")
+            assert goodput + wasted == emitted, (
+                f"token conservation broken: {goodput}+{wasted} != {emitted}")
+            client_tokens = calm_tokens + burst_tokens[0]
+            assert goodput >= client_tokens > 0, (
+                f"goodput {goodput} < client-received {client_tokens}")
+            row["occupancy"] = {**occ, "slot_steps": slot_steps,
+                                "goodput_tokens": goodput,
+                                "wasted_tokens": wasted,
+                                "emitted_tokens": emitted,
+                                "client_tokens": client_tokens,
+                                "overloads_429": overloads_seen[0]}
+            for h in ("queue_wait", "prefill", "decode_active", "slot_idle"):
+                assert f"kubeml_serving_{h}_seconds_bucket" in metrics, (
+                    f"phase histogram {h} missing from /metrics")
+
+            hist = traced_http.get(
+                f"{base}/metrics/history?stats=1&match=kubeml_serving",
+                timeout=10).json()
+            over_key = next(
+                (k for k in hist["series"]
+                 if k.startswith("kubeml_serving_requests_overload_total")),
+                None)
+            assert over_key is not None, "/metrics/history has no 429 series"
+            assert "rate" in hist["series"][over_key], "no windowed rate"
+            row["history"] = {
+                "series": len(hist["series"]),
+                "overload_rate_429s": hist["series"][over_key]["rate"],
+                "samples": len(hist["series"][over_key].get("samples", [])),
+            }
+
+            # serving spans: the traced request's span tree is fetchable by
+            # its request id, exactly like a train task's
+            if row["serving_request_id"]:
+                trace = cluster.ps.get_trace(row["serving_request_id"])
+                names = {s.get("name") for s in trace["spans"]}
+                assert "serving.request" in names, (
+                    f"no serving.request span for "
+                    f"{row['serving_request_id']}: {sorted(names)}")
+                row["trace"] = {"spans": len(trace["spans"]),
+                                "phases": sorted(
+                                    n for n in names
+                                    if str(n).startswith("serving."))}
+            row["slo_status"] = {
+                o["name"]: {"state": o["state"],
+                            "burn_fast": o["burn_fast"],
+                            "fired": o["fired_count"]}
+                for o in status["objectives"]}
+            row["status"] = "ok"
+    finally:
+        sink.shutdown()
+        # restore, don't just delete: a caller's real alerting endpoint
+        # must survive this scenario (later scenarios keep reporting to it)
+        if prior_webhook is None:
+            os.environ.pop("KUBEML_ERROR_WEBHOOK", None)
+        else:
+            os.environ["KUBEML_ERROR_WEBHOOK"] = prior_webhook
+    return row
+
+
 def run_all(config: Optional[Config] = None, quick: bool = True,
             names: Optional[List[str]] = None,
             max_parallelism: Optional[int] = None) -> List[ScenarioResult]:

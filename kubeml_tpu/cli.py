@@ -35,6 +35,15 @@ def _print(obj) -> None:
     print(json.dumps(obj, indent=2, default=str))
 
 
+def _print_table(cols, rows) -> None:
+    """Aligned column table (jobs/slo/top listings). Safe on empty rows."""
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+              for i, c in enumerate(cols)]
+    print("  ".join(c.ljust(widths[i]) for i, c in enumerate(cols)))
+    for r in rows:
+        print("  ".join(v.ljust(widths[i]) for i, v in enumerate(r)))
+
+
 # --- train (reference cmd/train.go:36-169) ---
 
 
@@ -327,11 +336,7 @@ def cmd_jobs(args) -> int:
              j.get("function", "") or "-",
              str(j["resume_epoch"]) if "resume_epoch" in j else "-")
             for j in jobs]
-    widths = [max(len(c), *(len(r[i]) for r in rows))
-              for i, c in enumerate(cols)]
-    print("  ".join(c.ljust(widths[i]) for i, c in enumerate(cols)))
-    for r in rows:
-        print("  ".join(v.ljust(widths[i]) for i, v in enumerate(r)))
+    _print_table(cols, rows)
     return 0
 
 
@@ -484,6 +489,135 @@ def cmd_profile(args) -> int:
         print(f"warning: {data['dropped']} spans dropped at the collector "
               f"cap — byte totals are a floor", file=sys.stderr)
     return 0
+
+
+# --- slo: burn rates and alert states (ps/slo.py via the controller) ---
+
+
+def cmd_slo(args) -> int:
+    """``kubeml slo [--json] [--events]``: the declared objectives with
+    their multi-window burn rates and alert states, plus the recorded
+    pending/firing/resolved transition history."""
+    data = _client(args).slo()
+    if args.json:
+        _print(data)
+        return 0
+    objs = data.get("objectives", [])
+    if not objs:
+        print("no SLO objectives declared (set KUBEML_SLOS)")
+        return 0
+    w = data.get("windows", {})
+    print(f"windows: fast={w.get('fast', '?')}s slow={w.get('slow', '?')}s  "
+          f"for={data.get('for_seconds', '?')}s "
+          f"resolve={data.get('resolve_for_seconds', '?')}s")
+
+    def fmt(v):
+        return "-" if v is None else f"{v:.4g}"
+
+    cols = ("SLO", "SIGNAL", "TARGET", "VALUE", "BURN(fast)", "BURN(slow)",
+            "STATE", "FIRED")
+    rows = [(o["name"], o["signal"], f"{o['op']}{o['target']:g}",
+             fmt(o.get("value_fast")), fmt(o.get("burn_fast")),
+             fmt(o.get("burn_slow")), o.get("state", "?"),
+             str(o.get("fired_count", 0)))
+            for o in objs]
+    _print_table(cols, rows)
+    events = data.get("events", [])
+    if args.events and events:
+        print("\ntransitions:")
+        for e in events:
+            ts = time.strftime("%H:%M:%S", time.localtime(e.get("t", 0)))
+            print(f"  {ts}  {e.get('slo')}: {e.get('from')} -> {e.get('to')}"
+                  f"  (burn fast={e.get('burn_fast')} "
+                  f"slow={e.get('burn_slow')})")
+    return 0
+
+
+# --- top: the live serving view, refreshing from /metrics/history ---
+
+
+def cmd_top(args) -> int:
+    """``kubeml top [-n N] [--interval S] [--once]``: a live serving-health
+    view — per-model occupancy, queue depth, tokens/sec, goodput ratio,
+    TTFT p99 — plus SLO burn rates, refreshing from the embedded
+    time-series store (``/metrics/history``) every ``--interval`` seconds
+    (KUBEML_TOP_INTERVAL)."""
+    cfg = get_config()
+    client = _client(args)
+    interval = args.interval if args.interval else cfg.top_interval
+    iterations = 1 if args.once else args.iterations
+
+    def metric(series: dict, name: str, model: str, *fields):
+        entry = series.get(f'{name}{{model="{model}"}}') or {}
+        for f in fields:
+            if f in entry:
+                return entry[f]
+        return None
+
+    def fmt(v, nd=2):
+        return "-" if v is None else f"{v:.{nd}f}"
+
+    n = 0
+    while True:
+        try:
+            hist = client.metrics_history(match="kubeml_", stats=True,
+                                          include_samples=False,
+                                          stats_window=cfg.top_window)
+            slo = client.slo()
+        except KubeMLError as e:
+            print(f"error: {e.message}", file=sys.stderr)
+            return 1
+        except KeyboardInterrupt:
+            # the documented exit for the refresh loop — a Ctrl-C landing
+            # mid-fetch must exit as cleanly as one landing mid-sleep
+            return 0
+        series = hist.get("series", {})
+        models = sorted({k.split('model="', 1)[1].split('"', 1)[0]
+                         for k in series if 'model="' in k})
+        if sys.stdout.isatty() and iterations != 1:
+            print("\x1b[2J\x1b[H", end="")  # clear + home
+        print(time.strftime("kubeml top — %H:%M:%S  ")
+              + f"(window {hist.get('stats_window', '?')}s)")
+        cols = ("MODEL", "TOK/S", "QUEUE", "OCC", "GOODPUT", "DEAD/S",
+                "TTFT-P99", "429/S")
+        rows = []
+        for m in models:
+            rows.append((
+                m,
+                fmt(metric(series, "kubeml_serving_goodput_tokens_total",
+                           m, "rate"), 1),
+                fmt(metric(series, "kubeml_serving_queue_depth", m,
+                           "latest"), 0),
+                fmt(metric(series, "kubeml_serving_slot_occupancy", m,
+                           "mean", "latest")),
+                fmt(metric(series, "kubeml_serving_goodput_ratio", m,
+                           "latest")),
+                fmt(metric(series,
+                           "kubeml_serving_occupancy_dead_steps_total", m,
+                           "rate"), 1),
+                fmt(metric(series,
+                           "kubeml_serving_first_token_p99_seconds", m,
+                           "max", "latest"), 3),
+                fmt(metric(series, "kubeml_serving_requests_overload_total",
+                           m, "rate"), 1),
+            ))
+        if rows:
+            _print_table(cols, rows)
+        else:
+            print("(no serving traffic sampled yet)")
+        objs = slo.get("objectives", [])
+        if objs:
+            print("slo: " + "  ".join(
+                f"{o['name']}[{o.get('state', '?')}] "
+                f"burn {o.get('burn_fast', 0):.2g}/{o.get('burn_slow', 0):.2g}"
+                for o in objs))
+        n += 1
+        if iterations and n >= iterations:
+            return 0
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0
 
 
 # --- start: boot the all-in-one cluster ---
@@ -713,6 +847,23 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--out", "-o", default=None,
                     help="write the Chrome trace here (default: stdout)")
     tr.set_defaults(fn=cmd_trace)
+
+    sl = sub.add_parser("slo",
+                        help="SLO burn rates and alert states (ps/slo.py)")
+    sl.add_argument("--json", action="store_true", help="raw JSON payload")
+    sl.add_argument("--events", action="store_true",
+                    help="include the alert transition history")
+    sl.set_defaults(fn=cmd_slo)
+
+    tp = sub.add_parser("top",
+                        help="live serving view (occupancy, queue, tok/s, "
+                             "burn rates) from /metrics/history")
+    tp.add_argument("-n", "--iterations", type=int, default=0,
+                    help="refresh N times then exit (0 = until Ctrl-C)")
+    tp.add_argument("--interval", type=float, default=0.0,
+                    help="refresh seconds (default KUBEML_TOP_INTERVAL)")
+    tp.add_argument("--once", action="store_true", help="print once and exit")
+    tp.set_defaults(fn=cmd_top)
 
     pr = sub.add_parser("profile",
                         help="per-phase byte/FLOP attribution report of a "

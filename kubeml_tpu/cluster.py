@@ -92,6 +92,9 @@ class LocalCluster:
     def start(self, recover: bool = True) -> "LocalCluster":
         self.cfg.enable_compilation_cache()
         self.scheduler.start()
+        # serving SLO observability: sample the registry into the embedded
+        # time-series store and evaluate the SLO engine on each tick
+        self.ps.start_telemetry()
         if self.preemption is not None:
             self.preemption.start()
             log.info("preemption controller running (queue>=%d, 429/s>=%g, "
@@ -120,6 +123,7 @@ class LocalCluster:
     def stop(self) -> None:
         if self.preemption is not None:
             self.preemption.stop()
+        self.ps.stop_telemetry()
         self.ps.shutdown_standalone_jobs()
         # stop threaded jobs BEFORE the shutdown announcement: a running
         # multi-host job holds the dist lock for its whole duration, and its

@@ -371,6 +371,24 @@ class KubemlClient:
     def checkpoints(self) -> _Checkpoints:
         return _Checkpoints(self)
 
+    def slo(self) -> dict:
+        """SLO burn/alert status (controller proxies the PS's /slo)."""
+        return _check(requests.get(f"{self.url}/slo",
+                                   timeout=requests.timeouts(self.timeout)))
+
+    def metrics_history(self, match: Optional[str] = None,
+                        window: Optional[float] = None, stats: bool = False,
+                        include_samples: bool = True,
+                        stats_window: Optional[float] = None) -> dict:
+        """Sampled time-series history (`kubeml top` refreshes from this)."""
+        from ..utils.timeseries import history_query
+
+        qs = history_query(match=match, window=window, stats=stats,
+                           include_samples=include_samples,
+                           stats_window=stats_window)
+        return _check(requests.get(f"{self.url}/metrics/history{qs}",
+                                   timeout=requests.timeouts(self.timeout)))
+
     def health(self) -> bool:
         try:
             return requests.get(f"{self.url}/health",

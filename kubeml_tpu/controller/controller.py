@@ -61,6 +61,10 @@ class Controller:
         router.route("DELETE", "/tasks/{id}", self._task_stop)
         router.route("POST", "/tasks/{id}/preempt", self._task_preempt)
         router.route("GET", "/tasks/{id}/trace", self._task_trace)
+        # serving SLO observability (PS proxies): burn/alert status for
+        # `kubeml slo`, sampled time-series history for `kubeml top`
+        router.route("GET", "/slo", self._slo)
+        router.route("GET", "/metrics/history", self._metrics_history)
         router.route("GET", "/history", self._history_list)
         router.route("GET", "/history/{id}", self._history_get)
         router.route("DELETE", "/history/{id}", self._history_delete)
@@ -162,6 +166,14 @@ class Controller:
 
     def _task_prune(self, req: Request):
         return {"pruned": self.ps.prune_tasks()}
+
+    def _slo(self, req: Request):
+        return self.ps.slo_status()
+
+    def _metrics_history(self, req: Request):
+        from ..utils.timeseries import history_kwargs
+
+        return self.ps.metrics_history(**history_kwargs(req.arg))
 
     def _task_trace(self, req: Request):
         """The task's merged distributed trace (spans from every process that

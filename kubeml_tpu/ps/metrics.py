@@ -56,6 +56,11 @@ RUNNING = "kubeml_job_running_total"
 LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
 
+# ratio edges (0..1) for the per-chunk batch-occupancy histogram: the live
+# fraction of device slot-steps (1.0 = every slot emitted every step)
+OCCUPANCY_BUCKETS = (0.0625, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875,
+                     0.9375, 1.0)
+
 
 class Histogram:
     """Minimal Prometheus histogram: fixed bucket edges, cumulative counts,
@@ -153,6 +158,35 @@ SERVING_COUNTERS = {
         "fetch_busy_seconds",
         "Cumulative wall seconds fetcher threads spent blocked on device "
         "result fetches (rate() / pool size = utilization)"),
+    # batch-occupancy / goodput accounting (per-device-step truth from the
+    # chunk loop — the before/after evidence for continuous batching)
+    "kubeml_serving_device_steps_total": (
+        "device_steps", "Decode steps executed on device (sum of chunk "
+                        "lengths)"),
+    "kubeml_serving_occupancy_slot_steps_total": (
+        "slot_steps", "Raw device slot-step capacity spent (steps x slots "
+                      "per chunk — the device-step token throughput "
+                      "denominator)"),
+    "kubeml_serving_occupancy_live_steps_total": (
+        "live_slot_steps", "Slot-steps that emitted a token (useful decode "
+                           "work)"),
+    "kubeml_serving_occupancy_dead_steps_total": (
+        "dead_slot_steps", "Slot-steps spent on a resident row that emitted "
+                           "nothing (finished/eos rows still stepping — the "
+                           "dead-step waste)"),
+    "kubeml_serving_occupancy_idle_steps_total": (
+        "idle_slot_steps", "Slot-steps with no resident row (free capacity)"),
+    "kubeml_serving_prefill_tokens_total": (
+        "prefill_tokens", "Real prompt tokens prefilled at admission"),
+    "kubeml_serving_prefill_pad_tokens_total": (
+        "prefill_pad_tokens", "Padding tokens computed at admission (prompt "
+                              "bucket + repeated-row padding)"),
+    "kubeml_serving_goodput_tokens_total": (
+        "goodput_tokens", "Tokens delivered to a live waiter (useful-token "
+                          "goodput vs device-step throughput)"),
+    "kubeml_serving_wasted_tokens_total": (
+        "wasted_tokens", "Tokens routed to a request whose waiter already "
+                         "gave up (timeout/cancel)"),
 }
 # per-job latency histograms (no reference counterpart — the gauges above
 # keep only the LAST epoch's value). Fed from MetricUpdate; series OUTLIVE
@@ -177,6 +211,20 @@ SERVING_HISTOGRAMS = {
         "request", "Full request latency distribution"),
     "kubeml_serving_decode_step_seconds": (
         "decode_step", "Per-decode-step device time (chunk fetch / steps)"),
+    # request lifecycle phases (one observation per admitted row)
+    "kubeml_serving_queue_wait_seconds": (
+        "queue_wait", "Submission to decode-slot assignment"),
+    "kubeml_serving_prefill_seconds": (
+        "prefill", "Slot assignment to the first token landing on the host "
+                   "(prefill program + fetch pipeline)"),
+    "kubeml_serving_decode_active_seconds": (
+        "decode_active", "First token to the row's last emitted token"),
+    "kubeml_serving_slot_idle_seconds": (
+        "slot_idle", "Slot held after the row's last token before the slot "
+                     "freed (completion-detection lag; ~0 for pre-freed "
+                     "drained rows)"),
+    "kubeml_serving_batch_occupancy_ratio": (
+        "occupancy_ratio", "Per-chunk live fraction of device slot-steps"),
 }
 
 SERVING_GAUGES = {
@@ -222,7 +270,16 @@ SERVING_GAUGES = {
     "kubeml_serving_fetcher_utilization": (
         "fetcher_utilization", "Busy fraction of the fetcher pool (in-flight "
                                "/ pool size at scrape time)"),
+    "kubeml_serving_goodput_ratio": (
+        "goodput_ratio", "Lifetime useful fraction of raw device slot-step "
+                         "capacity (live / total slot-steps)"),
 }
+
+
+# SLO engine series (ps/slo.py): burn rates per objective x window, and the
+# alert state machine's current state (0=inactive 1=pending 2=firing)
+SLO_BURN = "kubeml_slo_burn_rate"
+SLO_STATE = "kubeml_slo_alert_state"
 
 
 PREEMPTIONS = "kubeml_preemptions_total"
@@ -257,9 +314,15 @@ class MetricsRegistry:
         # () -> {model_id: telemetry dict} from the PS's resident decoders
         # (serving/batcher.telemetry); set by the PS, read at render time
         self._serving_source = None
+        # () -> {"burn": {(slo, window): x}, "state": {slo: 0|1|2}} from the
+        # SLO engine (ps/slo.py); read at render time
+        self._slo_source = None
 
     def set_serving_source(self, source) -> None:
         self._serving_source = source
+
+    def set_slo_source(self, source) -> None:
+        self._slo_source = source
 
     def set_queue_source(self, source) -> None:
         """() -> {priority: queued count} (scheduler.queue.depths); read at
@@ -361,6 +424,28 @@ class MetricsRegistry:
             # LIVE job's mark (which would double-count redelivered bytes)
             self._dp_applied.pop(job_id, None)
 
+    def running_snapshot(self) -> Dict[str, int]:
+        """{kind: running count} — the sampler's gauge read."""
+        with self._lock:
+            return dict(self._running)
+
+    def preemptions_snapshot(self) -> Dict[str, int]:
+        """{reason: count} — the sampler's counter read."""
+        with self._lock:
+            return dict(self._preemptions)
+
+    def queue_depths(self) -> Dict[object, int]:
+        """Per-priority queued counts from the bound queue source ({} when
+        unbound/broken) — read OUTSIDE the registry lock, same discipline
+        as render()."""
+        source = self._queue_source
+        if source is None:
+            return {}
+        try:
+            return dict(source() or {})
+        except Exception:
+            return {}
+
     def task_started(self, kind: str = "train") -> None:
         with self._lock:
             self._running[kind] = self._running.get(kind, 0) + 1
@@ -452,6 +537,28 @@ class MetricsRegistry:
                 if hist_snap:
                     lines.extend(Histogram.render_snapshot(
                         metric, hist_snap, "model", model))
+        # SLO burn rates + alert states (ps/slo.py). Headers render even
+        # with no engine/objectives — same stable-metric-set discipline.
+        lines.append(f"# HELP {SLO_BURN} SLO error-budget burn rate per "
+                     f"objective and window (1.0 = burning exactly the "
+                     f"budget)")
+        lines.append(f"# TYPE {SLO_BURN} gauge")
+        slo = {}
+        if self._slo_source is not None:
+            try:
+                slo = self._slo_source() or {}
+            except Exception:
+                slo = {}
+        for (name, window), burn in sorted((slo.get("burn") or {}).items()):
+            lines.append(
+                f'{SLO_BURN}{{slo="{escape_label_value(name)}",'
+                f'window="{escape_label_value(window)}"}} {burn:g}')
+        lines.append(f"# HELP {SLO_STATE} SLO alert state "
+                     f"(0=inactive 1=pending 2=firing)")
+        lines.append(f"# TYPE {SLO_STATE} gauge")
+        for name, state in sorted((slo.get("state") or {}).items()):
+            lines.append(
+                f'{SLO_STATE}{{slo="{escape_label_value(name)}"}} {int(state)}')
         # control-plane resilience counters (utils.resilience): retries,
         # breaker state/opens, deadline rejections, chaos injections —
         # process-local, rendered on the same exposition so one scrape sees

@@ -101,6 +101,41 @@ class ParameterServer:
         # serving telemetry: /metrics renders each resident decoder's
         # counters/latency quantiles next to the training gauges
         self.metrics.set_serving_source(self._serving_telemetry)
+        # embedded time-series store: the sampler polls the registry's
+        # serving/scheduler signals into bounded rings (GET /metrics/history;
+        # the SLO engine and `kubeml top` read windowed rates from it
+        # instead of growing their own). The interval thread starts with
+        # start_telemetry() (LocalCluster.start / PSAPI.start) — bare PS
+        # objects in tests drive ticks manually via self.sampler.tick().
+        from ..utils.timeseries import Sampler, TimeSeriesStore
+
+        self.tsdb = TimeSeriesStore(capacity=self.cfg.tsdb_samples,
+                                    max_series=self.cfg.tsdb_series)
+        # some gauges wear counter names (_total): running_total is the
+        # reference's name for a decremented gauge, slots_total a constant
+        # capacity — marked so /metrics/history stats render quantiles,
+        # not a bogus counter rate
+        from .metrics import RUNNING, SERVING_GAUGES
+
+        self.tsdb.mark_gauge(RUNNING)
+        for metric in SERVING_GAUGES:
+            if metric.endswith("_total"):
+                self.tsdb.mark_gauge(metric)
+        self.sampler = Sampler(self.tsdb, interval=self.cfg.tsdb_interval)
+        self.sampler.add_collector(self._collect_series)
+        # declarative SLO engine (ps/slo.py): objectives from KUBEML_SLOS,
+        # multi-window burn rates over the tsdb, alert state machine firing
+        # through the errorhook webhook. Evaluated on every sampler tick.
+        from .slo import SLOEngine, parse_objectives
+
+        self.slo = SLOEngine(
+            self.tsdb, parse_objectives(self.cfg.slo_spec),
+            fast_window=self.cfg.slo_fast_window,
+            slow_window=self.cfg.slo_slow_window,
+            for_s=self.cfg.slo_for,
+            resolve_for_s=self.cfg.slo_resolve_for)
+        self.sampler.add_tick_hook(self.slo.evaluate)
+        self.metrics.set_slo_source(self.slo.metrics_source)
         # span collector: job runners/workers POST finished spans here, the
         # controller's /tasks/{id}/trace merges them with local spans
         self.traces = TraceStore()
@@ -895,6 +930,58 @@ class ParameterServer:
         public read the preemption controller polls for overload signals
         (queue depth, 429 counters, request p99)."""
         return self._serving_telemetry()
+
+    # --- embedded time-series store + SLO engine (PR 11) ---
+
+    def start_telemetry(self) -> None:
+        """Start the interval sampler (idempotent; no-op with KUBEML_TSDB=0).
+        Called by LocalCluster.start / PSAPI.start — a bare PS in tests
+        drives ``self.sampler.tick()`` manually instead."""
+        if self.cfg.tsdb_enable:
+            self.sampler.start()
+
+    def stop_telemetry(self) -> None:
+        self.sampler.stop()
+
+    def _collect_series(self) -> Dict[str, float]:
+        """One registry sample: every serving counter/gauge per model (the
+        exposition's own name/label scheme so /metrics/history correlates
+        1:1 with /metrics), scheduler queue depths, running-task gauges and
+        the preemption counter."""
+        from .metrics import (PREEMPTIONS, QUEUE_DEPTH, RUNNING,
+                              SERVING_COUNTERS, SERVING_GAUGES)
+
+        out: Dict[str, float] = {}
+        for model, snap in self._serving_telemetry().items():
+            for table in (SERVING_COUNTERS, SERVING_GAUGES):
+                for metric, (key, _help) in table.items():
+                    v = snap.get(key)
+                    if v is not None:
+                        out[f'{metric}{{model="{model}"}}'] = float(v)
+        for kind, n in self.metrics.running_snapshot().items():
+            out[f'{RUNNING}{{type="{kind}"}}'] = float(n)
+        out[PREEMPTIONS] = float(
+            sum(self.metrics.preemptions_snapshot().values()))
+        for prio, n in self.metrics.queue_depths().items():
+            out[f'{QUEUE_DEPTH}{{priority="{prio}"}}'] = float(n)
+        return out
+
+    def metrics_history(self, match: Optional[str] = None,
+                        window: Optional[float] = None, stats: bool = False,
+                        include_samples: bool = True,
+                        stats_window: Optional[float] = None) -> dict:
+        """`GET /metrics/history`: the sampled time-series rings, with
+        windowed aggregates (rates for counters, quantiles for gauges) when
+        ``stats`` is set — what `kubeml top` refreshes from."""
+        return self.tsdb.history(
+            match=match, window=window, stats=stats,
+            include_samples=include_samples,
+            stats_window=(stats_window if stats_window is not None
+                          else self.cfg.top_window))
+
+    def slo_status(self) -> dict:
+        """`GET /slo`: objectives, burn rates, alert states, transitions."""
+        return self.slo.status()
 
     def get_task(self, job_id: str) -> TrainTask:
         with self._lock:

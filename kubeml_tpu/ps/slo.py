@@ -1,0 +1,359 @@
+"""Declarative SLO engine — objectives, multi-window burn rates, alerting.
+
+The scheduler-side consumers of serving health (the preemption controller
+today, the elastic serving autoscaler next) and human operators both need
+the same thing: not raw gauges but *"are we burning the error budget, and
+how fast"*. This module turns the embedded time-series store
+(utils/timeseries.py — the PS samples its registry into it every
+``KUBEML_TSDB_INTERVAL`` seconds) into that answer:
+
+* **Objectives** come from config/env (``KUBEML_SLOS``), a compact spec:
+  ``[name:]signal<=target[@burn]`` semicolon-separated, e.g.
+  ``availability>=0.99;overload_rate<=5;p99-ttft:ttft_p99<=0.5@2``.
+* **Burn rate** is the Google SRE Workbook quantity: how many times faster
+  than the error budget the system is currently burning. For availability
+  objectives burn = (1 - availability) / (1 - target); for rate/latency
+  ceilings burn = value / target. 1.0 = consuming exactly the budget.
+* **Multi-window**: each objective's burn is computed over a FAST and a
+  SLOW window (``KUBEML_SLO_{FAST,SLOW}_WINDOW``). An alert needs both
+  above the objective's burn threshold — the fast window catches "burning
+  now", the slow window proves it's sustained, and recovery drops the fast
+  window first so alerts resolve promptly (SRE Workbook ch. 5).
+* **Alert state machine**: inactive -> pending (condition met) -> firing
+  (held for ``KUBEML_SLO_FOR`` seconds) -> resolved (clear for
+  ``KUBEML_SLO_RESOLVE_FOR`` seconds) with every transition recorded in a
+  bounded history. Firing posts through the existing errorhook webhook
+  (utils.errorhook) — which also trips the flight-recorder dump, so an SLO
+  page arrives with the ring of recent spans/data-plane events attached.
+
+Exported as ``kubeml_slo_burn_rate{slo,window}`` and
+``kubeml_slo_alert_state{slo}`` on the PS /metrics (ps/metrics.py
+set_slo_source), served as JSON at ``GET /slo`` (``kubeml slo`` renders
+it), and evaluated on every sampler tick so burn always reflects the
+sample just taken.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..utils.timeseries import TimeSeriesStore
+
+log = logging.getLogger("kubeml.slo")
+
+# alert states (the kubeml_slo_alert_state gauge values)
+INACTIVE, PENDING, FIRING = 0, 1, 2
+STATE_NAMES = {INACTIVE: "inactive", PENDING: "pending", FIRING: "firing"}
+
+MAX_EVENTS = 256
+
+# `[name:]signal<=target[@burn]` — name charset mirrors metric labels
+_SPEC_RE = re.compile(
+    r"^(?:(?P<name>[A-Za-z0-9_.-]+):)?"
+    r"(?P<signal>[a-z0-9_]+)\s*(?P<op><=|>=)\s*"
+    r"(?P<target>[0-9.eE+-]+)(?:@(?P<burn>[0-9.eE+-]+))?$")
+
+# serving outcome counters that consume error budget vs the one that earns
+# it — the availability/error-rate signals difference these over the window
+_GOOD_COUNTERS = ("kubeml_serving_requests_completed_total",)
+_BAD_COUNTERS = (
+    "kubeml_serving_requests_failed_total",
+    "kubeml_serving_requests_timeout_total",
+    "kubeml_serving_requests_overload_total",
+    "kubeml_serving_requests_shed_total",
+    "kubeml_serving_deadline_expired_total",
+)
+
+KNOWN_SIGNALS = ("availability", "error_rate", "overload_rate", "ttft_p99",
+                 "request_p99", "queue_depth")
+
+
+@dataclass
+class Objective:
+    """One declared SLO: a signal, a comparison, a target, a burn threshold."""
+
+    name: str
+    signal: str
+    op: str        # "<=" (ceiling) or ">=" (floor; availability-style)
+    target: float
+    burn_threshold: float = 1.0
+
+    @staticmethod
+    def parse(spec: str) -> "Objective":
+        m = _SPEC_RE.match(spec.strip())
+        if m is None:
+            raise ValueError(
+                f"bad SLO spec {spec!r} (want `[name:]signal<=target[@burn]`)")
+        signal = m.group("signal")
+        if signal not in KNOWN_SIGNALS:
+            raise ValueError(
+                f"unknown SLO signal {signal!r} (known: "
+                f"{', '.join(KNOWN_SIGNALS)})")
+        target = float(m.group("target"))
+        op = m.group("op")
+        if op == ">=" and not (0.0 < target < 1.0):
+            raise ValueError(
+                f"floor objective {spec!r} needs a target in (0, 1) — the "
+                f"error budget is 1 - target")
+        if op == "<=" and target <= 0:
+            raise ValueError(f"ceiling objective {spec!r} needs target > 0")
+        return Objective(
+            name=m.group("name") or signal, signal=signal, op=op,
+            target=target,
+            burn_threshold=float(m.group("burn") or 1.0))
+
+    def burn(self, value: Optional[float]) -> float:
+        """Burn rate of this objective at the given signal value (0.0 when
+        the signal has no data — no traffic burns no budget)."""
+        if value is None:
+            return 0.0
+        if self.op == ">=":  # availability-style floor
+            return max(0.0, 1.0 - value) / max(1e-9, 1.0 - self.target)
+        return max(0.0, value) / self.target
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "signal": self.signal, "op": self.op,
+                "target": self.target, "burn_threshold": self.burn_threshold}
+
+
+def parse_objectives(spec: str) -> List[Objective]:
+    """Parse a ``KUBEML_SLOS`` spec string; a malformed objective is logged
+    and skipped (one typo must not take down the whole engine), duplicates
+    by name keep the first."""
+    out: List[Objective] = []
+    seen = set()
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            obj = Objective.parse(part)
+        except ValueError as e:
+            log.warning("skipping SLO objective: %s", e)
+            continue
+        if obj.name in seen:
+            log.warning("duplicate SLO objective name %r — keeping the first",
+                        obj.name)
+            continue
+        seen.add(obj.name)
+        out.append(obj)
+    return out
+
+
+@dataclass
+class _AlertState:
+    state: int = INACTIVE
+    since: float = 0.0          # when the current state began
+    cond_since: float = 0.0     # when the burn condition last became true
+    clear_since: float = 0.0    # when it last became false (while firing)
+    last_burn_fast: float = 0.0
+    last_burn_slow: float = 0.0
+    last_value_fast: Optional[float] = None
+    last_value_slow: Optional[float] = None
+    fired_count: int = 0
+
+
+class SLOEngine:
+    """Evaluates the declared objectives against the time-series store on
+    every sampler tick and drives the per-objective alert state machine."""
+
+    def __init__(self, store: TimeSeriesStore,
+                 objectives: List[Objective], *,
+                 fast_window: float = 60.0, slow_window: float = 300.0,
+                 for_s: float = 5.0, resolve_for_s: float = 15.0,
+                 on_alert=None):
+        self.store = store
+        self.objectives = list(objectives)
+        self.fast_window = float(fast_window)
+        self.slow_window = float(slow_window)
+        self.for_s = max(0.0, float(for_s))
+        self.resolve_for_s = max(0.0, float(resolve_for_s))
+        # on_alert(event_dict) — the webhook/flight-dump hook; None uses
+        # utils.errorhook.report_error directly
+        self._on_alert = on_alert
+        self._lock = threading.Lock()
+        self._states: Dict[str, _AlertState] = {
+            o.name: _AlertState() for o in self.objectives}
+        self._events: "deque[dict]" = deque(maxlen=MAX_EVENTS)
+
+    # --- signal evaluation (the tsdb queries) ---
+
+    def _counter_increase(self, metric: str, window: float,
+                          now: float) -> float:
+        """Summed increase of one counter family across its labeled series."""
+        return sum(s.increase(window, now=now)
+                   for s in self.store.matching(metric).values())
+
+    def _gauge_max(self, metric: str, window: float,
+                   now: float) -> Optional[float]:
+        """Worst (max) recent value of one gauge family over the window."""
+        vals = [v for s in self.store.matching(metric).values()
+                if (v := s.max_over(window, now=now)) is not None]
+        return max(vals) if vals else None
+
+    def signal_value(self, signal: str, window: float,
+                     now: Optional[float] = None) -> Optional[float]:
+        """Current value of a named signal over a window (None = no data)."""
+        if now is None:
+            now = time.time()
+        if signal in ("availability", "error_rate"):
+            good = sum(self._counter_increase(m, window, now)
+                       for m in _GOOD_COUNTERS)
+            bad = sum(self._counter_increase(m, window, now)
+                      for m in _BAD_COUNTERS)
+            total = good + bad
+            if total <= 0:
+                return None  # no traffic: the budget is not being spent
+            return (good / total) if signal == "availability" else (bad / total)
+        if signal == "overload_rate":
+            return self._counter_increase(
+                "kubeml_serving_requests_overload_total", window,
+                now) / max(window, 1e-3)
+        if signal in ("ttft_p99", "request_p99"):
+            # latency SLOs are REQUEST-based: the p99 gauges are rings of
+            # recent requests, so an idle server's gauge holds its last
+            # (possibly cold-compile) value forever — without traffic in
+            # the window that stale number must not burn budget or hold an
+            # alert firing on a quiet system
+            flowing = sum(self._counter_increase(m, window, now)
+                          for m in _GOOD_COUNTERS + _BAD_COUNTERS)
+            if flowing <= 0:
+                return None
+            metric = ("kubeml_serving_first_token_p99_seconds"
+                      if signal == "ttft_p99"
+                      else "kubeml_serving_latency_p99_seconds")
+            return self._gauge_max(metric, window, now)
+        if signal == "queue_depth":
+            return self._gauge_max(
+                "kubeml_serving_queue_depth", window, now)
+        return None
+
+    # --- the state machine ---
+
+    def evaluate(self, now: Optional[float] = None) -> None:
+        """One evaluation pass (a Sampler tick hook — runs right after the
+        registry sample lands, so burn reflects it)."""
+        if now is None:
+            now = time.time()
+        for obj in self.objectives:
+            vf = self.signal_value(obj.signal, self.fast_window, now)
+            vs = self.signal_value(obj.signal, self.slow_window, now)
+            burn_fast, burn_slow = obj.burn(vf), obj.burn(vs)
+            # multi-window condition: burning now AND sustained
+            cond = (burn_fast >= obj.burn_threshold
+                    and burn_slow >= obj.burn_threshold)
+            self._advance(obj, cond, burn_fast, burn_slow, vf, vs, now)
+
+    def _advance(self, obj: Objective, cond: bool, burn_fast: float,
+                 burn_slow: float, vf, vs, now: float) -> None:
+        fire_event = None
+        with self._lock:
+            st = self._states.setdefault(obj.name, _AlertState())
+            st.last_burn_fast, st.last_burn_slow = burn_fast, burn_slow
+            st.last_value_fast, st.last_value_slow = vf, vs
+            if st.state == INACTIVE:
+                if cond:
+                    st.state, st.since, st.cond_since = PENDING, now, now
+                    self._event(obj, st, "inactive", "pending", now)
+            elif st.state == PENDING:
+                if not cond:
+                    st.state, st.since = INACTIVE, now
+                    self._event(obj, st, "pending", "inactive", now)
+                elif now - st.cond_since >= self.for_s:
+                    st.state, st.since = FIRING, now
+                    st.clear_since = 0.0
+                    st.fired_count += 1
+                    fire_event = self._event(obj, st, "pending", "firing", now)
+            elif st.state == FIRING:
+                if cond:
+                    st.clear_since = 0.0  # hysteresis: the clear clock resets
+                else:
+                    if st.clear_since == 0.0:
+                        st.clear_since = now
+                    if now - st.clear_since >= self.resolve_for_s:
+                        st.state, st.since = INACTIVE, now
+                        fire_event = self._event(obj, st, "firing", "resolved",
+                                                 now)
+        if fire_event is not None:
+            self._notify(fire_event)
+
+    def _event(self, obj: Objective, st: _AlertState, frm: str, to: str,
+               now: float) -> dict:
+        """Record one transition (caller holds the lock); returns the event."""
+        e = {
+            "t": now, "slo": obj.name, "signal": obj.signal, "from": frm,
+            "to": to, "burn_fast": round(st.last_burn_fast, 4),
+            "burn_slow": round(st.last_burn_slow, 4),
+            "value_fast": st.last_value_fast, "value_slow": st.last_value_slow,
+            "target": obj.target, "burn_threshold": obj.burn_threshold,
+        }
+        self._events.append(e)
+        return e
+
+    def _notify(self, event: dict) -> None:
+        """Alert delivery: the errorhook webhook (which dumps the flight
+        recorder alongside) — never raises into the evaluation path."""
+        try:
+            if self._on_alert is not None:
+                self._on_alert(dict(event))
+                return
+            from ..utils.errorhook import report_error
+
+            verb = ("firing" if event["to"] == "firing" else event["to"])
+            report_error(
+                f"slo:{event['slo']}",
+                f"SLO {event['slo']} ({event['signal']}"
+                f"{'>=' if event['to'] == 'resolved' else ''} "
+                f"target {event['target']:g}) {verb}: burn "
+                f"fast={event['burn_fast']:g} slow={event['burn_slow']:g}",
+                **{k: v for k, v in event.items() if k != "t"})
+        except Exception:
+            log.debug("SLO alert delivery failed", exc_info=True)
+
+    # --- reads ---
+
+    def metrics_source(self) -> dict:
+        """The ps/metrics.py slo source: burn gauges + alert states."""
+        with self._lock:
+            burn = {}
+            state = {}
+            for name, st in self._states.items():
+                burn[(name, "fast")] = st.last_burn_fast
+                burn[(name, "slow")] = st.last_burn_slow
+                state[name] = st.state
+        return {"burn": burn, "state": state}
+
+    def status(self) -> dict:
+        """The ``GET /slo`` payload (``kubeml slo`` renders it)."""
+        with self._lock:
+            objectives = []
+            for obj in self.objectives:
+                st = self._states.get(obj.name) or _AlertState()
+                objectives.append({
+                    **obj.to_dict(),
+                    "state": STATE_NAMES.get(st.state, "?"),
+                    "since": st.since,
+                    "burn_fast": round(st.last_burn_fast, 4),
+                    "burn_slow": round(st.last_burn_slow, 4),
+                    "value_fast": st.last_value_fast,
+                    "value_slow": st.last_value_slow,
+                    "fired_count": st.fired_count,
+                })
+            events = list(self._events)
+        return {
+            "windows": {"fast": self.fast_window, "slow": self.slow_window},
+            "for_seconds": self.for_s,
+            "resolve_for_seconds": self.resolve_for_s,
+            "objectives": objectives,
+            "events": events,
+        }
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)

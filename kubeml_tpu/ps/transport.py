@@ -31,6 +31,11 @@ class PSAPI:
         router.route("GET", "/jobs", self._jobs)
         router.route("GET", "/tasks", self._tasks)
         router.route("GET", "/metrics", self._metrics)
+        # serving SLO observability: the embedded time-series store's
+        # sampled history (windowed rates/quantiles for `kubeml top` and
+        # remote consumers) and the SLO engine's burn/alert status
+        router.route("GET", "/metrics/history", self._metrics_history)
+        router.route("GET", "/slo", self._slo)
         # job-runner callbacks (reference routes /metrics/{jobId} and
         # /finish/{jobId}, ps/api.go:335-345)
         router.route("POST", "/metrics/{jobId}", self._metrics_update)
@@ -83,6 +88,14 @@ class PSAPI:
             self.ps.metrics.render().encode(), content_type="text/plain; version=0.0.4"
         )
 
+    def _metrics_history(self, req: Request):
+        from ..utils.timeseries import history_kwargs
+
+        return self.ps.metrics_history(**history_kwargs(req.arg))
+
+    def _slo(self, req: Request):
+        return self.ps.slo_status()
+
     def _metrics_update(self, req: Request):
         from ..api.types import MetricUpdate
 
@@ -117,9 +130,12 @@ class PSAPI:
 
     def start(self) -> "PSAPI":
         self.service.start()
+        # the HTTP surface is up: /metrics/history needs samples flowing
+        self.ps.start_telemetry()
         return self
 
     def stop(self) -> None:
+        self.ps.stop_telemetry()
         self.service.stop()
 
     @property
@@ -194,6 +210,22 @@ class PSClient:
     def metrics_text(self) -> str:
         return requests.get(f"{self.url}/metrics",
                             timeout=self._timeout()).text
+
+    def metrics_history(self, match: Optional[str] = None,
+                        window: Optional[float] = None, stats: bool = False,
+                        include_samples: bool = True,
+                        stats_window: Optional[float] = None) -> dict:
+        from ..utils.timeseries import history_query
+
+        qs = history_query(match=match, window=window, stats=stats,
+                           include_samples=include_samples,
+                           stats_window=stats_window)
+        return _check(requests.get(f"{self.url}/metrics/history{qs}",
+                                   timeout=self._timeout()))
+
+    def slo_status(self) -> dict:
+        return _check(requests.get(f"{self.url}/slo",
+                                   timeout=self._timeout()))
 
     def post_trace(self, task_id: str, spans: list,
                    counters: Optional[dict] = None,

@@ -42,8 +42,14 @@ from typing import Dict, Optional
 from ..api.config import Config, get_config
 from ..api.errors import KubeMLError
 from ..api.types import JobStateEnum, TrainRequest
+from ..utils.timeseries import Series
 
 log = logging.getLogger("kubeml.preemption")
+
+# window the 429-rate signal is computed over: matches the serving stats
+# ring window so the controller and the decoders' own overload_per_second
+# gauge describe the same quantity
+SIGNAL_WINDOW_S = 10.0
 
 
 class PreemptionController:
@@ -59,9 +65,12 @@ class PreemptionController:
         self._overloaded_polls = 0
         self._calm_polls = 0
         self._last_preempt = 0.0
-        # cumulative 429 counter at the previous poll (per-interval rate)
-        self._prev_overloads: Optional[float] = None
-        self._prev_poll_t: Optional[float] = None
+        # overload signal history: each poll records the summed cumulative
+        # 429 counter into a bounded ring and the rate is a windowed
+        # time-series query (utils.timeseries — the one windowed-rate
+        # implementation; this replaces the controller's hand-rolled
+        # previous-poll counter delta)
+        self._overload_series = Series(capacity=1024, kind="counter")
 
     # --- lifecycle ---
 
@@ -89,8 +98,11 @@ class PreemptionController:
 
     def signals(self) -> dict:
         """One poll of the serving overload signals, aggregated across the
-        resident decoders: worst-case queue depth and p99, total 429 rate
-        since the previous poll."""
+        resident decoders: worst-case queue depth and p99, and the windowed
+        429 rate — a time-series query over the polled cumulative counter
+        (Series.rate with burst-aware elapsed-span semantics: a burst
+        shorter than the window reads as its burst rate, which is what the
+        old per-poll counter delta provided)."""
         try:
             telemetry = self.ps.serving_telemetry() or {}
         except Exception:
@@ -102,15 +114,26 @@ class PreemptionController:
         overloads = sum(s.get("requests_overload", 0.0)
                         for s in telemetry.values())
         now = time.monotonic()
-        rate = 0.0
-        if self._prev_overloads is not None and self._prev_poll_t is not None:
-            dt = max(now - self._prev_poll_t, 1e-3)
-            rate = max(0.0, overloads - self._prev_overloads) / dt
-        self._prev_overloads = overloads
-        self._prev_poll_t = now
-        # prefer the decoders' own ~10s-window rate when available (smoother
-        # than a per-poll delta); keep the delta as the floor so a burst
-        # shorter than the window still registers
+        self._overload_series.observe(overloads, t=now)
+        # reset="clamp": this series SUMS per-decoder counters, and a
+        # decoder-cache eviction shrinks the sum without any new 429s —
+        # Prometheus reset semantics would read the survivors' full value
+        # as a fresh burst and preempt a healthy training job (the old
+        # hand-rolled delta clamped negatives for the same reason)
+        rate = self._overload_series.rate(SIGNAL_WINDOW_S, now=now,
+                                          span="elapsed", reset="clamp")
+        # per-poll burst floor: once the series is older than the window
+        # the elapsed span IS the window, which dilutes a burst landing in
+        # one poll ~window/interval-fold — the newest sample pair's own
+        # delta rate keeps the old per-poll sensitivity (clamped, same
+        # eviction reasoning as above)
+        recent = self._overload_series.samples(SIGNAL_WINDOW_S, now=now)
+        if len(recent) >= 2:
+            dt = max(recent[-1][0] - recent[-2][0], 1e-3)
+            rate = max(rate, max(0.0, recent[-1][1] - recent[-2][1]) / dt)
+        # prefer the decoders' own windowed rate when higher (their ring
+        # sees every 429 the instant it happens; the poll only sees the
+        # counter at poll resolution)
         rate = max(rate, sum(s.get("overload_per_second", 0.0)
                              for s in telemetry.values()))
         return {"queue_depth": queue_depth, "p99": p99,

@@ -1,0 +1,385 @@
+"""Embedded metrics time-series store — bounded rings with windowed queries.
+
+Before this module every consumer of "a rate over a window" grew its own
+implementation: serving/stats.py kept a hand-rolled deque of 429 timestamps
+for ``overload_per_second``, a second deque of (t, tokens) pairs for
+``tokens_per_second``, and the preemption controller differentiated raw
+cumulative counters between polls. The SLO engine (ps/slo.py) needs the same
+primitive again — multi-window burn rates are nothing but counter increases
+over two windows — so the window logic now exists exactly once:
+
+* :class:`Series` — one bounded ring of ``(t, value)`` samples with the
+  query surface every consumer shares: ``latest``, ``increase`` (counter
+  increase over a window, reset-aware), ``rate``, ``quantile``/``max_over``
+  /``mean_over`` (gauge aggregation over a window).
+* :class:`TimeSeriesStore` — a bounded registry of named Series. The PS
+  samples its /metrics registry into one on an interval and serves it at
+  ``GET /metrics/history``, which is what ``kubeml top`` and the SLO engine
+  read instead of scraping Prometheus.
+* :class:`Sampler` — the interval thread: polls collector callables into the
+  store and runs ``on_tick`` hooks (the SLO evaluation) after each sample.
+
+Counters vs gauges: a series whose name ends in ``_total`` follows the
+Prometheus counter convention and is stored as CUMULATIVE samples; rate
+queries difference them (negative deltas read as counter resets, Prometheus
+style). Everything else is a gauge sampled point-in-time.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+# bounded-by-default sizing: ~10 minutes of history at the 1 Hz default
+# sample interval, far above any burn-rate window the SLO engine defaults to
+DEFAULT_CAPACITY = 600
+DEFAULT_MAX_SERIES = 1024
+
+
+class Series:
+    """One bounded ring of ``(t, value)`` samples (thread-safe).
+
+    ``t`` defaults to ``time.time()`` so samples are comparable across
+    processes; callers with their own clock discipline (serving stats uses
+    ``time.monotonic``) pass ``t`` explicitly and query with the same clock.
+    """
+
+    __slots__ = ("_samples", "_lock", "kind")
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, kind: str = "gauge"):
+        self._samples: "deque[Tuple[float, float]]" = deque(
+            maxlen=max(2, int(capacity)))
+        self._lock = threading.Lock()
+        self.kind = kind
+
+    def observe(self, value: float, t: Optional[float] = None) -> None:
+        """Append one sample (for counters: the CUMULATIVE value)."""
+        with self._lock:
+            self._samples.append(
+                (float(t) if t is not None else time.time(), float(value)))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._samples)
+
+    def samples(self, window: Optional[float] = None,
+                now: Optional[float] = None) -> List[Tuple[float, float]]:
+        """Samples with ``t >= now - window`` (all when window is None)."""
+        with self._lock:
+            out = list(self._samples)
+        if window is None:
+            return out
+        if now is None:
+            now = time.time()
+        cut = now - float(window)
+        return [s for s in out if s[0] >= cut]
+
+    def latest(self) -> Optional[float]:
+        with self._lock:
+            return self._samples[-1][1] if self._samples else None
+
+    # --- counter queries ---
+
+    def increase(self, window: float, now: Optional[float] = None,
+                 reset: str = "count") -> float:
+        """Counter increase over ``[now - window, now]``: the sum of positive
+        deltas between consecutive samples in the window, anchored at the
+        last sample at-or-before the window start. A negative delta is a
+        counter reset; ``reset="count"`` counts the new value as the
+        increase (Prometheus semantics — a restarted process re-publishing
+        from zero). ``reset="clamp"`` counts a negative delta as 0: the
+        right policy for a series that is a SUM of component counters whose
+        components can disappear (e.g. per-decoder 429 counters summed
+        across an evicting decoder cache — an eviction shrinks the sum
+        without any new events, and counting the survivor's full value
+        would read as a burst that never happened)."""
+        if now is None:
+            now = time.time()
+        cut = now - float(window)
+        with self._lock:
+            snap = list(self._samples)
+        base = None  # counter value AT the window start (last sample <= cut)
+        inc = 0.0
+        prev = None
+        for t, v in snap:
+            if t <= cut:
+                base = v
+                continue
+            if prev is None:
+                prev = base if base is not None else v
+                # a series born inside the window anchors at its own first
+                # sample — its value before existing is unknowable, and
+                # counting it would spike the rate at every series birth
+            d = v - prev
+            if d >= 0:
+                inc += d
+            elif reset == "count":
+                inc += v
+            prev = v
+        return inc
+
+    def rate(self, window: float, now: Optional[float] = None,
+             span: Optional[str] = None, reset: str = "count") -> float:
+        """Per-second counter rate over the window: ``increase / window``.
+        ``span="elapsed"`` divides by the elapsed time the window actually
+        covers samples for instead (a 2-second-old burst then reads as its
+        burst rate, not diluted over the full window) — the semantics the
+        serving tokens/sec gauge has always had."""
+        if now is None:
+            now = time.time()
+        inc = self.increase(window, now=now, reset=reset)
+        if span == "elapsed":
+            inside = self.samples(window, now=now)
+            if not inside:
+                return 0.0
+            denom = max(now - inside[0][0], 1e-3)
+        else:
+            denom = max(float(window), 1e-3)
+        return inc / denom
+
+    # --- gauge queries ---
+
+    def quantile(self, q: float, window: Optional[float] = None,
+                 now: Optional[float] = None) -> Optional[float]:
+        """Nearest-rank quantile of the sample VALUES in the window (the
+        same estimator serving stats has always used); None when empty."""
+        vals = sorted(v for _, v in self.samples(window, now=now))
+        if not vals:
+            return None
+        idx = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
+        return vals[idx]
+
+    def max_over(self, window: Optional[float] = None,
+                 now: Optional[float] = None) -> Optional[float]:
+        vals = [v for _, v in self.samples(window, now=now)]
+        return max(vals) if vals else None
+
+    def mean_over(self, window: Optional[float] = None,
+                  now: Optional[float] = None) -> Optional[float]:
+        vals = [v for _, v in self.samples(window, now=now)]
+        return sum(vals) / len(vals) if vals else None
+
+
+class TimeSeriesStore:
+    """Bounded ``{name: Series}`` registry (oldest series evicts past the
+    cap — ephemeral label sets must not grow a resident server forever)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 max_series: int = DEFAULT_MAX_SERIES):
+        self.capacity = int(capacity)
+        self.max_series = int(max_series)
+        self._series: "OrderedDict[str, Series]" = OrderedDict()
+        # metric families whose *_total name lies about their kind (the
+        # reference's kubeml_job_running_total is a gauge it decrements)
+        self._gauge_overrides: set = set()
+        self._lock = threading.Lock()
+
+    def mark_gauge(self, metric: str) -> None:
+        """Force a metric family to gauge despite a ``_total`` name."""
+        with self._lock:
+            self._gauge_overrides.add(metric)
+
+    def kind_of(self, name: str) -> str:
+        """Prometheus naming convention: ``*_total`` series are counters
+        (unless explicitly marked as gauges)."""
+        metric = name.split("{", 1)[0]
+        if metric in self._gauge_overrides:
+            return "gauge"
+        return "counter" if metric.endswith("_total") else "gauge"
+
+    def series(self, name: str) -> Series:
+        """Get-or-create a series (kind inferred from the name). Recording
+        refreshes recency, so eviction past ``max_series`` drops the series
+        longest WITHOUT a sample — never one the sampler is actively
+        feeding (insertion-order eviction would thrash every live series
+        once the cap is crossed)."""
+        with self._lock:
+            s = self._series.get(name)
+            if s is None:
+                while len(self._series) >= self.max_series:
+                    self._series.popitem(last=False)
+                s = self._series[name] = Series(self.capacity,
+                                                kind=self.kind_of(name))
+            else:
+                self._series.move_to_end(name)
+            return s
+
+    def get(self, name: str) -> Optional[Series]:
+        with self._lock:
+            return self._series.get(name)
+
+    def record(self, name: str, value: float,
+               t: Optional[float] = None) -> None:
+        self.series(name).observe(value, t=t)
+
+    def record_many(self, values: Dict[str, float],
+                    t: Optional[float] = None) -> None:
+        if t is None:
+            t = time.time()
+        for name, value in values.items():
+            try:
+                self.series(name).observe(float(value), t=t)
+            except (TypeError, ValueError):
+                continue
+
+    def names(self, match: Optional[str] = None) -> List[str]:
+        with self._lock:
+            keys = list(self._series)
+        if match:
+            keys = [k for k in keys if match in k]
+        return sorted(keys)
+
+    def matching(self, metric: str) -> Dict[str, Series]:
+        """Every series of one metric family: exact name or any labeled
+        variant (``metric{...}``)."""
+        with self._lock:
+            return {k: s for k, s in self._series.items()
+                    if k == metric or k.startswith(metric + "{")}
+
+    def history(self, match: Optional[str] = None,
+                window: Optional[float] = None, stats: bool = False,
+                include_samples: bool = True,
+                stats_window: float = 30.0,
+                now: Optional[float] = None) -> dict:
+        """The ``GET /metrics/history`` payload: per-series samples and,
+        with ``stats``, the windowed aggregates consumers would otherwise
+        recompute (rate for counters; min/mean/max/p50/p99 for gauges)."""
+        if now is None:
+            now = time.time()
+        out: Dict[str, dict] = {}
+        for name in self.names(match):
+            s = self.get(name)
+            if s is None:
+                continue
+            entry: dict = {"kind": s.kind}
+            latest = s.latest()
+            if latest is not None:
+                entry["latest"] = latest
+            if include_samples:
+                entry["samples"] = [[round(t, 3), v] for t, v in
+                                    s.samples(window, now=now)]
+            if stats:
+                if s.kind == "counter":
+                    entry["rate"] = s.rate(stats_window, now=now)
+                    entry["increase"] = s.increase(stats_window, now=now)
+                else:
+                    for label, q in (("p50", 0.5), ("p99", 0.99)):
+                        v = s.quantile(q, stats_window, now=now)
+                        if v is not None:
+                            entry[label] = v
+                    v = s.max_over(stats_window, now=now)
+                    if v is not None:
+                        entry["max"] = v
+                    v = s.mean_over(stats_window, now=now)
+                    if v is not None:
+                        entry["mean"] = v
+            out[name] = entry
+        return {"now": now, "window": window, "stats_window": stats_window,
+                "series": out}
+
+
+def history_kwargs(arg) -> dict:
+    """Parse the ``/metrics/history`` query surface into
+    :meth:`TimeSeriesStore.history` kwargs. ``arg(name, default=None)`` is
+    the server's query accessor (utils.httpd Request.arg) — shared by the
+    PS route and the controller proxy so the two cannot drift."""
+    def farg(name):
+        v = arg(name)
+        try:
+            return float(v) if v not in (None, "") else None
+        except (TypeError, ValueError):
+            return None
+
+    return {
+        "match": arg("match") or None,
+        "window": farg("window"),
+        "stats": arg("stats", "0") != "0",
+        "include_samples": arg("samples", "1") != "0",
+        "stats_window": farg("stats_window"),
+    }
+
+
+def history_query(match: Optional[str] = None,
+                  window: Optional[float] = None, stats: bool = False,
+                  include_samples: bool = True,
+                  stats_window: Optional[float] = None) -> str:
+    """The client half of :func:`history_kwargs`: the query string for a
+    ``GET /metrics/history`` request ("" when everything is default)."""
+    from urllib.parse import quote
+
+    params = []
+    if match:
+        params.append(f"match={quote(match)}")
+    if window is not None:
+        params.append(f"window={window:g}")
+    if stats:
+        params.append("stats=1")
+    if not include_samples:
+        params.append("samples=0")
+    if stats_window is not None:
+        params.append(f"stats_window={stats_window:g}")
+    return ("?" + "&".join(params)) if params else ""
+
+
+class Sampler:
+    """Interval sampler: polls collector callables into a store, then runs
+    the tick hooks (SLO evaluation piggybacks here so burn rates are always
+    computed against the sample that was just taken).
+
+    A collector returns a flat ``{series_name: value}`` dict; a broken
+    collector is skipped for that tick, never fatal (sampling shares the
+    exposition's never-fail-the-scrape discipline)."""
+
+    def __init__(self, store: TimeSeriesStore, interval: float = 1.0):
+        self.store = store
+        self.interval = max(0.05, float(interval))
+        self._collectors: List[Callable[[], Dict[str, float]]] = []
+        self._hooks: List[Callable[[float], None]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def add_collector(self, fn: Callable[[], Dict[str, float]]) -> None:
+        if fn not in self._collectors:
+            self._collectors.append(fn)
+
+    def add_tick_hook(self, fn: Callable[[float], None]) -> None:
+        """``fn(now)`` runs after every sample tick."""
+        if fn not in self._hooks:
+            self._hooks.append(fn)
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """One sample pass (public: tests and in-process consumers drive
+        ticks manually instead of waiting out the interval thread)."""
+        if now is None:
+            now = time.time()
+        for fn in self._collectors:
+            try:
+                self.store.record_many(fn() or {}, t=now)
+            except Exception:
+                pass
+        for hook in self._hooks:
+            try:
+                hook(now)
+            except Exception:
+                pass
+
+    def start(self) -> "Sampler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, name="tsdb-sampler",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.tick()

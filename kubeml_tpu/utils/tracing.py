@@ -321,6 +321,30 @@ class Tracer:
         s.duration = duration
         self._append(s)
 
+    def add_span(self, name: str, start: float, duration: float, *,
+                 trace_id: Optional[str] = None,
+                 span_id: Optional[str] = None,
+                 parent_id: Optional[str] = None,
+                 service: Optional[str] = None,
+                 **attrs: Any) -> Optional[Span]:
+        """Record a fully-explicit span: wall start, duration, and (when
+        given) explicit trace identity. The serving batcher reconstructs a
+        request's phase timeline AFTER the fact — at completion, on the
+        engine thread, where no context manager ever wrapped the phases —
+        so it needs to name the parent/ids itself. Returns the Span (None
+        when disabled) so callers can hang children off its ``span_id``."""
+        if not self.enabled:
+            return None
+        s = Span(
+            name=name, start=float(start), duration=max(0.0, float(duration)),
+            thread=threading.get_ident(), attrs=attrs,
+            trace_id=trace_id or new_trace_id(),
+            span_id=span_id or new_span_id(),
+            parent_id=parent_id, service=service or self.service,
+        )
+        self._append(s)
+        return s
+
     # --- reading ---
 
     def spans(self, name: Optional[str] = None) -> List[Span]:

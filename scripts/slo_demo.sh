@@ -1,0 +1,64 @@
+#!/usr/bin/env bash
+# Serving SLO observability demo — the PR-11 acceptance drive:
+# a live standalone cluster is pushed through an induced overload (a client
+# burst past KUBEML_SERVING_QUEUE_LIMIT). The run proves, end to end:
+#   * per-request lifecycle histograms + serving spans (`kubeml trace`
+#     works for a serving request id);
+#   * occupancy/dead-step/goodput counters on /metrics that sum exactly
+#     (live+dead+idle == slot-steps; goodput+wasted == emitted tokens);
+#   * GET /metrics/history returning windowed rates from the embedded
+#     time-series store;
+#   * an SLO alert transitioning pending -> firing -> resolved, the firing
+#     delivered through the errorhook webhook with the flight-recorder tail.
+# A machine-readable row appends to results/slo_demo.jsonl.
+#
+#   scripts/slo_demo.sh [--full]     (default: quick sizing)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p results
+
+QUICK=1
+if [[ "${1:-}" == "--full" ]]; then QUICK=0; fi
+
+TRACE_DIR="$(mktemp -d)/traces"
+
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+KUBEML_TRACE="$TRACE_DIR" \
+KUBEML_SERVING_SLOTS=2 \
+KUBEML_SERVING_QUEUE_LIMIT="${KUBEML_SERVING_QUEUE_LIMIT:-4}" \
+KUBEML_TSDB_INTERVAL="${KUBEML_TSDB_INTERVAL:-0.2}" \
+KUBEML_SLOS="${KUBEML_SLOS:-availability>=0.95;overload_rate<=2.0}" \
+KUBEML_SLO_FAST_WINDOW="${KUBEML_SLO_FAST_WINDOW:-3}" \
+KUBEML_SLO_SLOW_WINDOW="${KUBEML_SLO_SLOW_WINDOW:-10}" \
+KUBEML_SLO_FOR="${KUBEML_SLO_FOR:-1}" \
+KUBEML_SLO_RESOLVE_FOR="${KUBEML_SLO_RESOLVE_FOR:-3}" \
+KUBEML_DATA_ROOT="${KUBEML_DATA_ROOT:-$(mktemp -d)/kubeml}" \
+python - "$QUICK" <<'EOF'
+import json, sys
+
+quick = sys.argv[1] == "1"
+
+from kubeml_tpu.benchmarks.scenarios import run_slo_overload
+
+row = run_slo_overload(quick=quick)
+
+# --- the acceptance invariants, asserted on the recorded row ---
+assert row["status"] == "ok"
+kinds = {(t["from"], t["to"]) for t in row["transitions"]}
+assert ("inactive", "pending") in kinds, "no pending transition recorded"
+assert ("pending", "firing") in kinds, "no firing transition recorded"
+assert ("firing", "resolved") in kinds, "no resolve transition recorded"
+assert row["alert_webhook"]["context"].startswith("slo:"), \
+    "alert did not arrive through the errorhook webhook"
+assert row["occupancy"]["overloads_429"] > 0, "the burst never hit the limit"
+assert row["history"]["samples"] > 0, "/metrics/history returned no samples"
+assert row.get("trace", {}).get("spans", 0) > 0, \
+    "no serving spans for the traced request id"
+
+with open("results/slo_demo.jsonl", "a") as f:
+    f.write(json.dumps(row) + "\n")
+print(json.dumps(row, indent=2))
+print("\nSLO demo PASSED: alert fired through the webhook and resolved; "
+      "occupancy/goodput counters sum consistently; windowed rates served "
+      "from /metrics/history; serving spans traceable by request id.")
+EOF

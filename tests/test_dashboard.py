@@ -1,0 +1,133 @@
+"""Grafana dashboard drift guard (fast tier-1).
+
+Every panel expression in ``deploy/grafana/kubeml-dashboard.json`` must
+reference only metric names some module actually exports — PR 6 shipped a
+``*_total``-suffix typo on a gauge panel that exactly this test would have
+caught. The exported-name universe is built by RENDERING a fully-seeded
+registry (serving telemetry with every histogram fed, job histograms,
+preemption/yield/queue series, resilience counters, profiler data-plane
+counters, SLO burn/state) rather than hand-listing names, so the test can't
+itself drift from the renderers.
+"""
+
+import json
+import re
+from pathlib import Path
+
+DASHBOARD = Path(__file__).parent.parent / "deploy" / "grafana" / \
+    "kubeml-dashboard.json"
+
+_NAME_RE = re.compile(r"kubeml_[a-z0-9_]+")
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _exported_names() -> set:
+    """Every metric name a fully-seeded exposition render emits."""
+    from kubeml_tpu.api.types import MetricUpdate
+    from kubeml_tpu.ps.metrics import MetricsRegistry
+    from kubeml_tpu.serving.stats import DecoderStats
+    from kubeml_tpu.utils import profiler, resilience
+
+    reg = MetricsRegistry()
+    # job gauges + histograms
+    reg.update(MetricUpdate(job_id="drift-job", validation_loss=1.0,
+                            accuracy=0.5, train_loss=1.0, parallelism=2,
+                            epoch_duration=1.0, moe_overflow=0.1,
+                            round_seconds=[0.1], merge_seconds=0.2))
+    reg.task_started()
+    # preemption series + per-priority queue gauges
+    reg.preemption("drift")
+    reg.observe_yield(0.5)
+    reg.set_queue_source(lambda: {0: 1})
+    # serving telemetry: one decoder with every counter/gauge/histogram fed
+    stats = DecoderStats(slots=4)
+    stats.submitted(1)
+    stats.first_token(0.05)
+    stats.completed(0.2)
+    stats.emitted(8)
+    stats.emitted(2, wasted=True)
+    stats.overloaded()
+    stats.shed()
+    stats.deadline_expired()
+    stats.timed_out()
+    stats.canceled()
+    stats.failed()
+    stats.rejected()
+    stats.admitted_wave()
+    stats.chunk()
+    stats.chunk_fetched(0.08, 8)
+    stats.chunk_occupancy(8, 20, 6, 6)
+    stats.admit_tokens(10, 22)
+    stats.fetch_started()
+    stats.fetch_finished(0.01)
+    stats.fetchers_total = 4
+    for phase in ("queue_wait", "prefill", "decode_active", "slot_idle"):
+        stats.phase(phase, 0.01)
+    snap = stats.snapshot()
+    snap.update({"queue_depth": 1.0, "slots_busy": 1.0, "slots_total": 4.0,
+                 "slot_occupancy": 0.25, "weight_bytes": 1024.0,
+                 "queue_limit": 16.0})
+    reg.set_serving_source(lambda: {"drift-model": snap})
+    # SLO burn/state gauges
+    reg.set_slo_source(lambda: {"burn": {("drift", "fast"): 0.5},
+                                "state": {"drift": 0}})
+    # resilience + profiler families render inside reg.render(); seed the
+    # conditional ones so their series (not just HELP headers) exist
+    resilience.incr("kubeml_http_retries_total", "drift-dest")
+    profiler.account("drift.phase", 1024, 0.1)
+    profiler.record_retry("drift.phase")
+
+    text = reg.render()
+    names = set()
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            names.add(line.split()[2])
+        elif line and not line.startswith("#"):
+            names.add(re.split(r"[{ ]", line, 1)[0])
+    return names
+
+
+def _dashboard_names() -> dict:
+    """{metric name: [panel titles referencing it]} from every target expr."""
+    doc = json.loads(DASHBOARD.read_text())
+    refs = {}
+    for panel in doc.get("panels", []):
+        for target in panel.get("targets", []):
+            for name in _NAME_RE.findall(target.get("expr", "")):
+                refs.setdefault(name, []).append(panel.get("title", "?"))
+    return refs
+
+
+def test_dashboard_parses_and_has_panels():
+    doc = json.loads(DASHBOARD.read_text())
+    assert doc.get("panels"), "dashboard has no panels"
+    assert all(p.get("targets") for p in doc["panels"]), \
+        "every panel needs at least one target expression"
+
+
+def test_every_panel_metric_is_exported():
+    exported = _exported_names()
+    missing = {}
+    for name, panels in _dashboard_names().items():
+        base = name
+        for suf in _HIST_SUFFIXES:
+            if name.endswith(suf) and name[: -len(suf)] in exported:
+                base = name[: -len(suf)]
+                break
+        if base not in exported and name not in exported:
+            missing[name] = sorted(set(panels))
+    assert not missing, (
+        f"dashboard panels reference metrics no module exports: {missing}")
+
+
+def test_new_observability_panels_present():
+    """The PR-11 panels: occupancy ratio, goodput vs device tokens, SLO
+    burn rate — the dashboard must chart the new accounting."""
+    refs = _dashboard_names()
+    for metric in ("kubeml_serving_batch_occupancy_ratio_bucket",
+                   "kubeml_serving_goodput_tokens_total",
+                   "kubeml_serving_occupancy_dead_steps_total",
+                   "kubeml_slo_burn_rate",
+                   "kubeml_slo_alert_state",
+                   "kubeml_serving_queue_wait_seconds_bucket"):
+        assert metric in refs, f"no panel charts {metric}"

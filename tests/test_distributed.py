@@ -172,7 +172,16 @@ def test_dashboard_metrics_exist_in_registry():
     stats.chunk_fetched(0.1, 10)
     stats.fetch_started()
     stats.fetch_finished(0.01)
+    # lifecycle-phase + occupancy histograms (PR 11 panels query them)
+    for phase in ("queue_wait", "prefill", "decode_active", "slot_idle"):
+        stats.phase(phase, 0.01)
+    stats.chunk_occupancy(8, live=10, dead=2, idle=4)
+    stats.admit_tokens(real=6, padding=10)
+    stats.emitted(4)
     reg.set_serving_source(lambda: {"m": stats.snapshot()})
+    # SLO burn/state gauges (the burn-rate and alert-state panels)
+    reg.set_slo_source(lambda: {"burn": {("o", "fast"): 0.5},
+                                "state": {"o": 0}})
     # one blocking data-plane transfer so the staging-bandwidth _bucket
     # series renders (the dashboard's bandwidth quantile panel queries it)
     from kubeml_tpu.utils import profiler

@@ -242,3 +242,186 @@ class Model(KubeModel):
     def configure_optimizers(self):
         return optax.adamw(self.lr)
 """
+
+
+# --- DecoderStats boundary behavior (PR 11: the SLO engine sits on these) ---
+
+
+def test_stats_quantiles_empty_ring():
+    """n=0: no latency keys at all — the SLO engine must read absence, not
+    zeros (a 0.0 p99 would read as a perfect SLO with no traffic)."""
+    snap = DecoderStats(slots=4).snapshot()
+    for key in ("latency_p50_seconds", "latency_p99_seconds",
+                "latency_max_seconds", "first_token_p50_seconds",
+                "first_token_p99_seconds"):
+        assert key not in snap
+    assert snap["tokens_per_second"] == 0.0
+    assert snap["overload_per_second"] == 0.0
+    assert "hist" not in snap
+
+
+def test_stats_quantiles_single_sample():
+    """n=1: every quantile collapses to the one observation (the nearest-
+    rank estimator's degenerate case)."""
+    s = DecoderStats(slots=4)
+    s.completed(0.7)
+    s.first_token(0.2)
+    snap = s.snapshot()
+    for q in ("p50", "p95", "p99", "max"):
+        assert snap[f"latency_{q}_seconds"] == 0.7
+        assert snap[f"first_token_{q}_seconds"] == 0.2
+
+
+def test_stats_latency_ring_evicts_at_cap():
+    """The bounded ring holds exactly LATENCY_RING recent observations:
+    past the cap the oldest evict, so quantiles track RECENT behavior (an
+    old outlier must age out) while the cumulative histogram keeps it."""
+    from kubeml_tpu.serving.stats import LATENCY_RING
+
+    s = DecoderStats(slots=4)
+    s.completed(99.0)  # the outlier that must age out
+    for _ in range(LATENCY_RING):
+        s.completed(0.1)
+    snap = s.snapshot()
+    assert snap["latency_max_seconds"] == 0.1      # evicted from the ring
+    assert snap["hist"]["request"]["count"] == LATENCY_RING + 1  # kept here
+    assert len(s._lat) == LATENCY_RING
+
+
+def test_stats_rate_window_across_idle_gap(monkeypatch):
+    """The ~10s token/429 rate windows decay to zero across an idle gap —
+    and wake back up on fresh traffic (the Series-backed windows that
+    replaced the hand-rolled deques)."""
+    import kubeml_tpu.serving.stats as stats_mod
+
+    clock = [1000.0]
+    monkeypatch.setattr(stats_mod.time, "monotonic", lambda: clock[0])
+    s = DecoderStats(slots=4)
+    for i in range(5):
+        clock[0] = 1000.0 + i
+        s.emitted(10)
+        s.overloaded()
+    clock[0] = 1004.5
+    assert s.tokens_per_second() > 0.0
+    assert s.overload_per_second() == pytest.approx(0.5)  # 5 in 10s
+    # idle: the window slides past the last event
+    clock[0] = 1030.0
+    assert s.tokens_per_second() == 0.0
+    assert s.overload_per_second() == 0.0
+    # fresh traffic after the gap registers immediately
+    s.emitted(20)
+    clock[0] = 1030.1
+    assert s.tokens_per_second() > 0.0
+
+
+# --- lifecycle phases + occupancy/goodput accounting ---
+
+
+def test_stats_phase_histograms_and_occupancy_accounting():
+    s = DecoderStats(slots=4)
+    s.phase("queue_wait", 0.05)
+    s.phase("prefill", 0.1)
+    s.phase("decode_active", 0.4)
+    s.phase("slot_idle", 0.0)
+    s.phase("nonsense", 1.0)  # unknown phases are ignored, not fatal
+    s.chunk_occupancy(8, live=24, dead=4, idle=4)   # 8 steps x 4 slots
+    s.chunk_occupancy(8, live=8, dead=0, idle=24)
+    s.admit_tokens(real=12, padding=52)
+    s.emitted(20)
+    s.emitted(4, wasted=True)
+    snap = s.snapshot()
+    assert snap["device_steps"] == 16.0
+    assert snap["slot_steps"] == 64.0
+    # the three kinds partition the slot-steps exactly
+    assert (snap["live_slot_steps"] + snap["dead_slot_steps"]
+            + snap["idle_slot_steps"]) == snap["slot_steps"]
+    assert snap["goodput_ratio"] == pytest.approx(32.0 / 64.0)
+    assert snap["prefill_tokens"] == 12.0
+    assert snap["prefill_pad_tokens"] == 52.0
+    assert snap["goodput_tokens"] == 20.0
+    assert snap["wasted_tokens"] == 4.0
+    assert snap["tokens_emitted"] == 24.0  # goodput + wasted
+    hist = snap["hist"]
+    for key in ("queue_wait", "prefill", "decode_active", "slot_idle",
+                "occupancy_ratio"):
+        assert hist[key]["count"] >= 1
+    assert hist["occupancy_ratio"]["count"] == 2
+    assert hist["occupancy_ratio"]["sum"] == pytest.approx(0.75 + 0.25)
+
+
+def test_decoder_lifecycle_and_occupancy_under_traffic():
+    """End-to-end through the real engine: phase histograms fill, the
+    occupancy partition sums exactly to the slot-steps, goodput tokens
+    reconcile with the request-level token counts, and the result carries
+    the request id."""
+    m = tiny()
+    variables = m.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    dec = BatchingDecoder(m, variables, slots=2, chunk_steps=4)
+    try:
+        entries = [dec.submit(GenerateRequest(
+            prompts=[[i + 1, i + 2, i + 3]], max_new_tokens=6))
+            for i in range(3)]
+        results = [dec.wait(e, timeout=300) for e in entries]
+        t = dec.telemetry()
+        # every admitted row went queued -> slot -> prefill -> decode
+        hist = t["hist"]
+        assert hist["queue_wait"]["count"] == 3
+        assert hist["prefill"]["count"] == 3
+        assert hist["decode_active"]["count"] == 3
+        assert hist["slot_idle"]["count"] == 3
+        # occupancy partition: live + dead + idle == steps x slots, always
+        assert t["slot_steps"] == t["device_steps"] * 2
+        assert (t["live_slot_steps"] + t["dead_slot_steps"]
+                + t["idle_slot_steps"]) == t["slot_steps"]
+        assert hist["occupancy_ratio"]["count"] == t["chunks"]
+        # token conservation: goodput == tokens the waiters actually got,
+        # and chunk-emitted tokens == live slot-steps + admit first tokens
+        delivered = sum(sum(r["lengths"]) for r in results)
+        assert t["goodput_tokens"] == delivered == 18.0
+        assert t["wasted_tokens"] == 0.0
+        assert t["live_slot_steps"] == t["tokens_emitted"] - 3  # 3 firsts
+        # prefill accounting: 3 real prompts of 3 tokens
+        assert t["prefill_tokens"] == 9.0
+        assert t["prefill_pad_tokens"] > 0.0  # bucket + row padding exists
+        # the per-request handle rides the result
+        assert all(r["request_id"] for r in results)
+        assert len({r["request_id"] for r in results}) == 3
+    finally:
+        dec.close()
+
+
+def test_decoder_emits_serving_spans_when_traced():
+    """With tracing on, a served request leaves a serving.request span tree
+    tagged job=<request_id> — `kubeml trace <request-id>` works for serving
+    requests, not just train tasks."""
+    from kubeml_tpu.utils import tracing
+
+    tracer = tracing.get_tracer()
+    was_enabled = tracer.enabled
+    tracer.enabled = True
+    m = tiny()
+    variables = m.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    dec = BatchingDecoder(m, variables, slots=2, chunk_steps=4)
+    try:
+        entry = dec.submit(GenerateRequest(prompts=[[1, 2, 3]],
+                                           max_new_tokens=6))
+        result = dec.wait(entry, timeout=300)
+        req_id = result["request_id"]
+        spans = tracer.task_spans(req_id)
+        names = {s.name for s in spans}
+        assert "serving.request" in names
+        assert "serving.queue_wait" in names
+        assert "serving.prefill" in names
+        assert "serving.decode" in names
+        req_span = next(s for s in spans if s.name == "serving.request")
+        assert req_span.attrs["outcome"] == "completed"
+        assert req_span.attrs["tokens"] == 6
+        # children parent under the request span, one trace
+        for s in spans:
+            if s.name.startswith("serving.") and s is not req_span:
+                assert s.trace_id == req_span.trace_id
+                assert s.parent_id == req_span.span_id
+    finally:
+        dec.close()
+        tracer.enabled = was_enabled
+        tracer.clear()
