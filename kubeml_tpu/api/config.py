@@ -287,6 +287,30 @@ class Config:
     # (default) = single-device serving.
     serving_mesh: str = field(
         default_factory=lambda: os.environ.get("KUBEML_SERVING_MESH", ""))
+    # --- paged KV-cache serving (serving/kvpool.py + PagedBatchingDecoder) ---
+    # serve capable causal-LM models through the paged engine: block
+    # allocator over a shared KV arena, page-budget admission at every
+    # chunk edge, shared-prefix reuse. Models without a paged decode path
+    # (MoE-interleaved, non-CausalTransformer) and meshed serving fall back
+    # to the dense slot engine automatically.
+    serving_paged: bool = field(
+        default_factory=lambda: _env_bool("KUBEML_SERVING_PAGED", True))
+    # tokens per physical KV page (power of two). Smaller = finer-grained
+    # memory + more prefix-sharing opportunities, larger = smaller page
+    # tables and fewer scatter indices per program.
+    serving_page_tokens: int = field(
+        default_factory=lambda: _env_int("KUBEML_SERVING_PAGE_TOKENS", 16))
+    # total pages in the device arena (including the reserved trash page).
+    # 0 (default) derives slots x ceil(max_len / page_tokens) + 1 — the slot
+    # engine's worst case, so the default never admission-regresses; size it
+    # DOWN for the memory win on short-request traffic.
+    serving_pages: int = field(
+        default_factory=lambda: _env_int("KUBEML_SERVING_PAGES", 0))
+    # shared-prefix KV reuse: identical leading prompt blocks (system
+    # prompts, few-shot headers) map to the same refcounted pages and
+    # prefill runs only on the unshared suffix
+    serving_prefix_cache: bool = field(
+        default_factory=lambda: _env_bool("KUBEML_SERVING_PREFIX_CACHE", True))
 
     def serving_mesh_axes(self) -> dict:
         """Parsed ``serving_mesh`` ({} when disabled); same ``ax=n`` comma

@@ -57,8 +57,15 @@ def one_shot_rate(batch: int, new_tokens: int = NEW_TOKENS, reps: int = 3) -> fl
 
 def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
              new_tokens: int = NEW_TOKENS, stagger: float = 0.0,
-             quantize: str = "", int8_matmul: bool = False) -> dict:
-    """N HTTP clients against a live cluster serving a final checkpoint."""
+             quantize: str = "", int8_matmul: bool = False,
+             paged: bool = False, mixed_prompts: bool = False,
+             long_workload: bool = False) -> dict:
+    """N HTTP clients against a live cluster serving a final checkpoint.
+
+    ``paged`` routes serving through the paged KV-cache engine
+    (PagedBatchingDecoder); ``mixed_prompts`` gives each client its own
+    prompt length (8..PROMPT_LEN cycling) — the chat-shaped mixed-length
+    traffic the paged allocator exists for."""
     import os
     import socket
     import tempfile
@@ -79,7 +86,7 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
     cfg = Config(controller_port=fp(), scheduler_port=fp(), ps_port=fp(),
                  storage_port=fp(), serving_slots=slots,
                  serving_chunk_steps=chunk_steps, serving_quantize=quantize,
-                 int8_matmul=int8_matmul)
+                 int8_matmul=int8_matmul, serving_paged=paged)
     cfg.ensure_dirs()
     set_config(cfg)
 
@@ -118,6 +125,15 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
     url = cfg.controller_url
     body = {"model_id": "servejob",
             "prompts": prompt.tolist(), "max_new_tokens": new_tokens}
+    # mixed-length traffic: each client runs its own prompt length so rows
+    # of different depths share the decode program — the workload shape the
+    # slot engine wastes stripes on and the paged engine is built for
+    bodies = [body] * clients
+    if mixed_prompts:
+        lens = [8 + 8 * (i % (PROMPT_LEN // 8)) for i in range(clients)]
+        bodies = [{**body,
+                   "prompts": prompt[:, :lens[i]].tolist()}
+                  for i in range(clients)]
     # warmup: compiles prefill + admit + step-chunk once
     w = requests.post(f"{url}/generate", json=body, timeout=600)
     assert w.ok, w.text
@@ -135,7 +151,8 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
         while time.perf_counter() < stop:
             t0 = time.perf_counter()
             try:
-                resp = sess.post(f"{url}/generate", json=body, timeout=300)
+                resp = sess.post(f"{url}/generate", json=bodies[i],
+                                 timeout=300)
                 if not resp.ok:
                     errors.append(resp.text)
                     return
@@ -165,11 +182,17 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
 
     total = sum(counts)
     return {
-        "metric": "serving-continuous-batching-throughput",
+        # only the explicit --long-workload flag renames the row: plain
+        # --new-tokens 256 runs keep appending to the historical metric
+        # name (results/serving_r5_load.jsonl trend tooling groups on it)
+        "metric": ("serving-long-workload-throughput" if long_workload
+                   else "serving-continuous-batching-throughput"),
         "clients": clients,
         "slots": slots,
         "chunk_steps": chunk_steps,
         "new_tokens": new_tokens,
+        "paged": paged,
+        "mixed_prompts": mixed_prompts,
         "stagger": stagger,
         "seconds": round(elapsed, 1),
         "value": round(total / elapsed, 1),
@@ -197,8 +220,24 @@ def main(argv=None) -> int:
                    help="native int8 decode matmuls (with --quantize int8): "
                         "contract activations against the int8 weights "
                         "directly instead of dequantizing first")
+    p.add_argument("--paged", action="store_true",
+                   help="serve through the paged KV-cache engine "
+                        "(PagedBatchingDecoder: block allocator, page-budget "
+                        "admission, shared-prefix reuse)")
+    p.add_argument("--mixed-prompts", action="store_true",
+                   help="give each client its own prompt length (mixed-depth "
+                        "rows in one decode program)")
+    p.add_argument("--long-workload", action="store_true",
+                   help="the gated long row: 256 new tokens over "
+                        "mixed-length prompts — the ~0.53 fraction "
+                        "results/SERVING_R5_NOTE.md measured, now tracked "
+                        "through scripts/bench_compare.py "
+                        "(serving_fraction_of_one_shot)")
     p.add_argument("--skip-comparator", action="store_true")
     args = p.parse_args(argv)
+    if args.long_workload:
+        args.new_tokens = max(args.new_tokens, 256)
+        args.mixed_prompts = True
     # the dev chip is SHARED: its deliverable rate swings 2-7x between
     # minutes (observed comparator range 1.9k-14.6k tokens/sec for the same
     # program). Bracket the load window with comparator runs and score
@@ -206,7 +245,9 @@ def main(argv=None) -> int:
     ref_before = None if args.skip_comparator else one_shot_rate(args.slots, args.new_tokens)
     row = run_load(args.clients, args.seconds, args.slots, args.chunk_steps,
                    new_tokens=args.new_tokens, stagger=args.stagger,
-                   quantize=args.quantize, int8_matmul=args.int8_matmul)
+                   quantize=args.quantize, int8_matmul=args.int8_matmul,
+                   paged=args.paged, mixed_prompts=args.mixed_prompts,
+                   long_workload=args.long_workload)
     if args.quantize:
         row["quantize"] = args.quantize
         row["int8_matmul"] = bool(args.int8_matmul)

@@ -538,10 +538,10 @@ def cmd_slo(args) -> int:
 
 def cmd_top(args) -> int:
     """``kubeml top [-n N] [--interval S] [--once]``: a live serving-health
-    view — per-model occupancy, queue depth, tokens/sec, goodput ratio,
-    TTFT p99 — plus SLO burn rates, refreshing from the embedded
-    time-series store (``/metrics/history``) every ``--interval`` seconds
-    (KUBEML_TOP_INTERVAL)."""
+    view — per-model occupancy, paged-KV page occupancy, queue depth,
+    tokens/sec, goodput ratio, TTFT p99 — plus SLO burn rates, refreshing
+    from the embedded time-series store (``/metrics/history``) every
+    ``--interval`` seconds (KUBEML_TOP_INTERVAL)."""
     cfg = get_config()
     client = _client(args)
     interval = args.interval if args.interval else cfg.top_interval
@@ -578,8 +578,8 @@ def cmd_top(args) -> int:
             print("\x1b[2J\x1b[H", end="")  # clear + home
         print(time.strftime("kubeml top — %H:%M:%S  ")
               + f"(window {hist.get('stats_window', '?')}s)")
-        cols = ("MODEL", "TOK/S", "QUEUE", "OCC", "GOODPUT", "DEAD/S",
-                "TTFT-P99", "429/S")
+        cols = ("MODEL", "TOK/S", "QUEUE", "OCC", "PAGES", "GOODPUT",
+                "DEAD/S", "TTFT-P99", "429/S")
         rows = []
         for m in models:
             rows.append((
@@ -589,6 +589,10 @@ def cmd_top(args) -> int:
                 fmt(metric(series, "kubeml_serving_queue_depth", m,
                            "latest"), 0),
                 fmt(metric(series, "kubeml_serving_slot_occupancy", m,
+                           "mean", "latest")),
+                # paged-arena occupancy (PagedBatchingDecoder; "-" on the
+                # dense slot engine, which has no page pool)
+                fmt(metric(series, "kubeml_serving_page_occupancy", m,
                            "mean", "latest")),
                 fmt(metric(series, "kubeml_serving_goodput_ratio", m,
                            "latest")),

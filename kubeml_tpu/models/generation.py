@@ -78,6 +78,47 @@ def init_cache(module, variables, batch: int) -> dict:
                         vars_out["cache"])
 
 
+def init_paged_cache(module, variables, batch: int, table_pages: int) -> dict:
+    """A zeroed PAGED KV-cache pytree: per-layer physical page arenas
+    ``[kv_pages, page_tokens, H, D]`` (the module carries ``kv_pages`` /
+    ``page_tokens`` — the serving layer clones them in) addressed through
+    per-row page tables. Shapes come from ``jax.eval_shape`` over a
+    one-token paged decode apply, so no device work happens; like
+    :func:`init_cache`, ``variables`` may be an abstract tree (the
+    quantized path sizes the arena without materializing dense weights).
+    The arena shape is independent of ``batch`` — prefill programs of any
+    row count share the same cache tree."""
+    dummy = jnp.zeros((batch, 1), jnp.int32)
+    pos = jnp.zeros((batch,), jnp.int32)
+    pages = jnp.zeros((batch, table_pages), jnp.int32)
+
+    def shape_fn(vs):
+        return module.apply(vs, dummy, decode=True, positions=pos,
+                            pages=pages, mutable=["cache"])
+
+    _, vars_out = jax.eval_shape(shape_fn, variables)
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                        vars_out["cache"])
+
+
+def supports_paged_decode(module) -> bool:
+    """Whether ``module`` can serve through the paged KV-cache engine:
+    it must expose the ``pages``/``seq_lens`` decode kwargs plus the
+    clonable ``page_tokens``/``kv_pages`` arena fields, and not interleave
+    MoE blocks (their expert attention has no paged path)."""
+    import inspect
+
+    if getattr(module, "moe_every", 0):
+        return False
+    if not (hasattr(module, "page_tokens") and hasattr(module, "kv_pages")):
+        return False
+    try:
+        params = inspect.signature(module.__call__).parameters
+    except (TypeError, ValueError):
+        return False
+    return "pages" in params and "seq_lens" in params and "positions" in params
+
+
 def _sample(logits, rng, temperature: float, top_k: Optional[int]):
     """One next-token draw per row from [B, V] logits (f32)."""
     if temperature <= 0.0:

@@ -53,9 +53,18 @@ class CausalSelfAttention(nn.Module):
     # not capped by max_len (the parent skips its learned pos_embed add)
     rope: bool = False
     rope_theta: float = 10000.0
+    # PAGED KV cache (kubeml_tpu.serving.kvpool): when a block table is
+    # passed at call time the cache collection holds one shared physical
+    # arena ``[kv_pages, page_tokens, H, D]`` instead of per-row
+    # ``[B, max_len, ...]`` stripes; rows address it through per-row page
+    # tables, so rows of different lengths share one step program without
+    # padding every row to max_len. 0/0 (default) = dense cache only.
+    page_tokens: int = 0
+    kv_pages: int = 0
 
     @nn.compact
-    def __call__(self, x, valid, decode: bool = False, positions=None):
+    def __call__(self, x, valid, decode: bool = False, positions=None,
+                 pages=None, seq_lens=None):
         if self.sp_impl not in ("ring", "ulysses"):
             raise ValueError(
                 f"unknown sp_impl {self.sp_impl!r} (valid: 'ring', 'ulysses')"
@@ -91,6 +100,59 @@ class CausalSelfAttention(nn.Module):
             if self.mesh is not None and self.mesh.shape.get("sp", 1) > 1:
                 raise ValueError("decode does not run under sequence "
                                  "parallelism; use an sp=1 mesh for serving")
+            if pages is not None:
+                # PAGED decode (serving.kvpool): the cache is one shared
+                # physical arena [kv_pages, pt, H, D]; each row addresses
+                # its own logical window through ``pages`` [B, P] (logical
+                # page j of row b lives at physical page pages[b, j]).
+                # ``positions`` [B] is the logical position of each row's
+                # FIRST token this call — L == 1 per-token steps and L > 1
+                # suffix prefill (shared-prefix reuse: the cached prefix is
+                # already in the arena, only the suffix runs) share this one
+                # code path. Writes are coordinate scatters at
+                # (physical page, offset); invalid positions (bucket
+                # padding, rows the host retired) are redirected to
+                # physical page 0 — the pool's reserved trash page — so a
+                # stale row can never corrupt a reallocated page. Reads
+                # gather the row's whole table back into a contiguous
+                # [B, P*pt, H, D] block (one gather per layer per step; the
+                # Pallas per-page-DMA kernel is the chip follow-up) and
+                # attend under the purely positional causal mask — every
+                # logical position <= the query's is real by construction
+                # (prompts are dense, decode writes are contiguous).
+                if self.page_tokens <= 0 or self.kv_pages <= 0:
+                    raise ValueError(
+                        "paged decode needs page_tokens/kv_pages > 0 on the "
+                        "module (the serving layer clones them in)")
+                if positions is None:
+                    raise ValueError("paged decode needs per-row positions")
+                pt, npg = self.page_tokens, self.kv_pages
+                tw = pages.shape[1]  # table width (logical pages per row)
+                ck = self.variable("cache", "k_pages", jnp.zeros,
+                                   (npg, pt, H, D), k.dtype)
+                cv = self.variable("cache", "v_pages", jnp.zeros,
+                                   (npg, pt, H, D), v.dtype)
+                pos_full = positions[:, None] + jnp.arange(L)  # [B, L]
+                if self.rope:
+                    from ..ops.rotary import apply_rope
+
+                    q = apply_rope(q, pos_full, self.rope_theta)
+                    k = apply_rope(k, pos_full, self.rope_theta)
+                wvalid = (jnp.arange(L)[None, :] < seq_lens[:, None]
+                          if seq_lens is not None
+                          else valid.astype(jnp.bool_))
+                page_idx = jnp.clip(pos_full // pt, 0, tw - 1)
+                phys = jnp.take_along_axis(pages, page_idx, axis=1)  # [B, L]
+                phys = jnp.where(wvalid, phys, 0)
+                off = pos_full % pt
+                ck.value = ck.value.at[phys, off].set(k)
+                cv.value = cv.value.at[phys, off].set(v)
+                kg = ck.value[pages].reshape(B, tw * pt, H, D)
+                vg = cv.value[pages].reshape(B, tw * pt, H, D)
+                k_pos = jnp.arange(tw * pt)[None, None, None, :]
+                mask = k_pos <= pos_full[:, None, :, None]  # [B, 1, L, tw*pt]
+                out = dot_product_attention(q, kg, vg, mask=mask)
+                return out_proj(out.reshape(B, L, H * D))
             Lc = self.cache_len
             ck = self.variable("cache", "k", jnp.zeros, (B, Lc, H, D), k.dtype)
             cv = self.variable("cache", "v", jnp.zeros, (B, Lc, H, D), v.dtype)
@@ -198,10 +260,12 @@ class GPTBlock(nn.Module):
     cache_len: int = 0
     rope: bool = False
     rope_theta: float = 10000.0
+    page_tokens: int = 0
+    kv_pages: int = 0
 
     @nn.compact
     def __call__(self, x, valid, train: bool = False, decode: bool = False,
-                 positions=None):
+                 positions=None, pages=None, seq_lens=None):
         y = nn.LayerNorm(name="ln1", dtype=jnp.float32,
                          epsilon=self.ln_eps)(x).astype(self.dtype)
         y = CausalSelfAttention(self.num_heads, mesh=self.mesh,
@@ -209,8 +273,11 @@ class GPTBlock(nn.Module):
                                 use_bias=self.attn_bias,
                                 cache_len=self.cache_len,
                                 rope=self.rope, rope_theta=self.rope_theta,
+                                page_tokens=self.page_tokens,
+                                kv_pages=self.kv_pages,
                                 name="attn")(y, valid, decode=decode,
-                                             positions=positions)
+                                             positions=positions,
+                                             pages=pages, seq_lens=seq_lens)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(name="ln2", dtype=jnp.float32,
@@ -266,10 +333,16 @@ class CausalTransformer(nn.Module):
     # through the residual). Decode always routes uncapped — capacity
     # competition is not causally consistent (parallel/moe.py)
     moe_capacity: float = 1.25
+    # --- paged KV cache (decode only; kubeml_tpu.serving.kvpool clones
+    # these in — page_tokens tokens per physical page, kv_pages pages in
+    # the shared arena). 0/0 keeps the dense per-row cache. ---
+    page_tokens: int = 0
+    kv_pages: int = 0
 
     @nn.compact
     def __call__(self, token_ids, train: bool = False, decode: bool = False,
-                 return_hidden: bool = False, positions=None):
+                 return_hidden: bool = False, positions=None, pages=None,
+                 seq_lens=None):
         token_ids = token_ids.astype(jnp.int32)
         B, L = token_ids.shape
         if decode:
@@ -298,11 +371,19 @@ class CausalTransformer(nn.Module):
                                    lambda: jnp.zeros((), jnp.int32))
             if positions is not None:
                 # per-row cursors (continuous batching): the shared scalar is
-                # meaningless, each row's position embedding is its own gather
+                # meaningless, each row's position embedding is its own
+                # gather. ``positions`` is the logical position of the FIRST
+                # token this call (L == 1 per-token steps; L > 1 paged
+                # suffix prefill) — the clip keeps bucket-padding rows,
+                # whose nominal positions can run past the table, from an
+                # out-of-bounds gather (their output is discarded anyway).
                 if use_rope:
                     x = x.astype(self.dtype)
                 else:
-                    x = (x + pos[0][positions][:, None, :]).astype(self.dtype)
+                    pos_full = jnp.clip(
+                        positions[:, None] + jnp.arange(L),
+                        0, self.max_len - 1)  # [B, L]
+                    x = (x + pos[0][pos_full]).astype(self.dtype)
             else:
                 i0 = cursor.value
                 cursor.value = i0 + L
@@ -316,6 +397,11 @@ class CausalTransformer(nn.Module):
             x = x.astype(self.dtype)
         else:
             x = (x + pos[:, :L]).astype(self.dtype)
+        if pages is not None and self.moe_every > 0:
+            # MoEBlock's expert attention has no paged path; the serving
+            # layer probes this and falls back to the dense engine
+            raise ValueError("paged decode does not cover MoE-interleaved "
+                             "models; serve them through the dense cache")
         for i in range(self.depth):
             if self.moe_every > 0 and (i + 1) % self.moe_every == 0:
                 from ..parallel.moe import MoEBlock
@@ -344,11 +430,14 @@ class CausalTransformer(nn.Module):
                                   attn_bias=self.attn_bias,
                                   cache_len=self.max_len if decode else 0,
                                   rope=use_rope, rope_theta=self.rope_theta,
+                                  page_tokens=self.page_tokens,
+                                  kv_pages=self.kv_pages,
                                   name=f"block_{i}")
                 # positions only exists on the decode path, which never remats
                 # — keeping the training call positional preserves the remat
                 # wrapper's static_argnums contract
-                x = (block(x, valid, train, decode, positions=positions)
+                x = (block(x, valid, train, decode, positions=positions,
+                           pages=pages, seq_lens=seq_lens)
                      if decode else block(x, valid, train, decode))
         x = nn.LayerNorm(name="ln_f", dtype=jnp.float32,
                          epsilon=self.ln_eps)(x).astype(self.dtype)

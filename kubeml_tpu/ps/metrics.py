@@ -187,6 +187,13 @@ SERVING_COUNTERS = {
     "kubeml_serving_wasted_tokens_total": (
         "wasted_tokens", "Tokens routed to a request whose waiter already "
                          "gave up (timeout/cancel)"),
+    # shared-prefix reuse (paged engine, serving/kvpool.py)
+    "kubeml_serving_prefix_hits_total": (
+        "prefix_hits", "Admissions whose leading prompt blocks were served "
+                       "from the shared-prefix KV cache"),
+    "kubeml_serving_prefix_tokens_saved_total": (
+        "prefix_tokens_saved", "Prompt tokens whose prefill was skipped "
+                               "because their KV pages were prefix-cached"),
 }
 # per-job latency histograms (no reference counterpart — the gauges above
 # keep only the LAST epoch's value). Fed from MetricUpdate; series OUTLIVE
@@ -273,6 +280,20 @@ SERVING_GAUGES = {
     "kubeml_serving_goodput_ratio": (
         "goodput_ratio", "Lifetime useful fraction of raw device slot-step "
                          "capacity (live / total slot-steps)"),
+    # paged KV arena (PagedBatchingDecoder only — absent on dense decoders)
+    "kubeml_serving_pages_total": (
+        "pages_total", "Allocatable KV pages in the paged arena (excludes "
+                       "the reserved trash page)"),
+    "kubeml_serving_pages_free": (
+        "pages_free", "KV pages on the free list right now"),
+    "kubeml_serving_page_occupancy": (
+        "page_occupancy", "Allocated fraction of the paged KV arena"),
+    "kubeml_serving_page_tokens": (
+        "page_tokens", "Tokens per physical KV page "
+                       "(KUBEML_SERVING_PAGE_TOKENS)"),
+    "kubeml_serving_prefix_cache_pages": (
+        "prefix_cache_pages", "Pages currently held by the shared-prefix "
+                              "trie (evictable when unreferenced)"),
 }
 
 

@@ -1307,23 +1307,40 @@ class ParameterServer:
             if (cached is not None and cached[1] == mtime
                     and not cached[0].closed):
                 return cached[0]
-        from ..serving import BatchingDecoder
+        from ..serving import BatchingDecoder, PagedBatchingDecoder
 
         quantize = self.cfg.serving_quantize
         if quantize not in ("", "int8"):
             log.warning("KUBEML_SERVING_QUANTIZE=%r not recognized "
                         "(valid: int8) — serving unquantized", quantize)
             quantize = ""
-        decoder = BatchingDecoder(
-            module, variables, slots=self.cfg.serving_slots,
+        common = dict(
+            slots=self.cfg.serving_slots,
             chunk_steps=self.cfg.serving_chunk_steps, name=model_id,
-            mesh=mesh, quantize=quantize,
+            quantize=quantize,
             int8_matmul=self.cfg.int8_matmul,
             pipeline_depth=self.cfg.serving_pipeline,
             fetchers=self.cfg.serving_fetchers,
             pressure_sizing=self.cfg.serving_pressure_sizing,
             queue_limit=self.cfg.serving_queue_limit,
             shed_policy=self.cfg.serving_shed_policy)
+        # paged engine (KUBEML_SERVING_PAGED, default on) for capable
+        # models on an unmeshed device: paged KV arena + block allocator,
+        # page-budget admission, shared-prefix reuse. Meshed serving and
+        # models without a paged decode path (MoE-interleaved) keep the
+        # dense slot engine.
+        from ..models.generation import supports_paged_decode
+
+        if (self.cfg.serving_paged and mesh is None
+                and supports_paged_decode(module)):
+            decoder = PagedBatchingDecoder(
+                module, variables,
+                page_tokens=self.cfg.serving_page_tokens,
+                pages=self.cfg.serving_pages,
+                prefix_cache=self.cfg.serving_prefix_cache,
+                **common)
+        else:
+            decoder = BatchingDecoder(module, variables, mesh=mesh, **common)
         stale = []
         with self._lock:
             # double-checked: a racing thread may have built one meanwhile —

@@ -6,9 +6,15 @@ counterpart there. On TPU, decode throughput is a near-linear function of
 batch (chip-measured 459 -> 6,517 tokens/sec at batch 1 -> 16,
 results/generation_r3_decode.jsonl), so serving one request per program
 execution leaves ~93% of the chip idle. :class:`BatchingDecoder` coalesces
-concurrent requests into one slot-based batched decode loop.
+concurrent requests into one slot-based batched decode loop;
+:class:`PagedBatchingDecoder` (the default for capable models) replaces the
+per-row ``[max_len, H, D]`` cache stripes with a paged KV arena + block
+allocator (serving/kvpool.py): page-budget admission at every chunk edge
+and shared-prefix reuse across requests.
 """
 
-from .batcher import BatchingDecoder, DecoderClosed
+from .batcher import BatchingDecoder, DecoderClosed, PagedBatchingDecoder
+from .kvpool import KVPool, PageLease, PrefixTrie
 
-__all__ = ["BatchingDecoder", "DecoderClosed"]
+__all__ = ["BatchingDecoder", "PagedBatchingDecoder", "DecoderClosed",
+           "KVPool", "PageLease", "PrefixTrie"]

@@ -58,6 +58,11 @@ log = logging.getLogger("kubeml.serving")
 # at this bound (api.types.GENERATE_MAX_TOP_K mirrors it on the wire).
 TOP_K_MAX = 128
 
+# default decode-row count shared by both engines: PagedBatchingDecoder must
+# size its arena BEFORE the base __init__ resolves slots, so the fallback
+# lives in one place instead of two drifting literals
+DEFAULT_SLOTS = 8
+
 _F32_NEG_INF = jnp.finfo(jnp.float32).min
 
 
@@ -223,6 +228,10 @@ class _Row:
     # is already in the dispatch chain, so the slot was handed to the next
     # admission without waiting for the row's results to come back
     drained: bool = False
+    # --- paged engine only (PagedBatchingDecoder) ---
+    lease: Optional[object] = None  # kvpool.PageLease while pages are held
+    prefix_cached: int = 0          # prompt tokens served from the prefix trie
+    dispatched: int = 0             # post-admit steps already in the chain
     # lifecycle timeline (monotonic; 0 = not reached): slot assignment,
     # first/last token landing on the host — the phase-histogram feeds
     slot_at: float = 0.0
@@ -260,7 +269,12 @@ class _Entry:
         tokens = [r.out + [PAD_ID] * (self.max_new - len(r.out))
                   for r in self.rows]
         return {"tokens": tokens, "lengths": [len(r.out) for r in self.rows],
-                "request_id": self.request_id}
+                "request_id": self.request_id,
+                # prompt tokens whose KV came from the shared-prefix cache
+                # (summed across the request's rows; 0 on the dense engine
+                # or with KUBEML_SERVING_PREFIX_CACHE off)
+                "prefix_cached_tokens": sum(r.prefix_cached
+                                            for r in self.rows)}
 
 
 def _pow2_bucket(n: int, lo: int, hi: int) -> int:
@@ -268,6 +282,56 @@ def _pow2_bucket(n: int, lo: int, hi: int) -> int:
     while b < n:
         b *= 2
     return min(b, hi)
+
+
+class _FetchPool:
+    """The result-fetch thread pool both engine loops share: dispatched
+    device programs are materialized off-thread (each fetch pays the
+    host<->device round trip), the engine consumes them in dispatch order.
+    ``stats`` hooks feed the kubeml_serving_fetch* observability."""
+
+    def __init__(self, decoder, n: int):
+        self.q: queue.Queue = queue.Queue()
+        self.done: Dict[int, tuple] = {}
+        self.cv = threading.Condition()
+        self._decoder = decoder
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"decode-fetch-{decoder.name}-{i}")
+            for i in range(n)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _run(self):
+        dec = self._decoder
+        while True:
+            item = self.q.get()
+            if item is None:
+                return
+            seq, rec = item
+            dec.stats.fetch_started()
+            t0 = time.monotonic()
+            try:
+                out = dec._materialize(rec)
+            except Exception as e:  # surfaces on the engine thread
+                out = ("error", e)
+            finally:
+                dec.stats.fetch_finished(time.monotonic() - t0)
+            with self.cv:
+                self.done[seq] = out
+                self.cv.notify_all()
+
+    def submit(self, seq: int, rec: tuple) -> None:
+        self.q.put((seq, rec))
+
+    def clear(self) -> None:
+        with self.cv:
+            self.done.clear()
+
+    def stop(self) -> None:
+        for _ in self._threads:
+            self.q.put(None)
 
 
 class BatchingDecoder:
@@ -278,7 +342,7 @@ class BatchingDecoder:
     chip. One background thread owns the device loop.
     """
 
-    def __init__(self, module, variables, *, slots: int = 8,
+    def __init__(self, module, variables, *, slots: int = DEFAULT_SLOTS,
                  chunk_steps: int = 8, bucket_min: int = 16,
                  pipeline_depth: Optional[int] = None, name: str = "decoder",
                  mesh=None, quantize: str = "",
@@ -476,11 +540,12 @@ class BatchingDecoder:
 
     # --- device programs ---
 
-    def _apply_step(self, variables, cache, tok, pos):
+    def _apply_step(self, variables, cache, tok, pos, pages=None):
         variables = self._dense_vars(variables)
+        kw = {} if pages is None else {"pages": pages}
         logits, vs = self.module.apply(
             {**variables, "cache": cache}, tok[:, None], decode=True,
-            positions=pos, mutable=["cache"])
+            positions=pos, mutable=["cache"], **kw)
         return logits[:, -1].astype(jnp.float32), vs["cache"]
 
     def _dense_vars(self, variables):
@@ -496,9 +561,10 @@ class BatchingDecoder:
 
         return dequantize_tree(variables, dtype=jnp.float32)
 
-    def _step_impl(self, variables, slab, steps=None):
+    def _step_impl(self, variables, slab, pages=None, steps=None):
         """Advance every slot ``steps`` tokens (one program per size in
-        ``_chunk_sizes``).
+        ``_chunk_sizes``). ``pages`` (paged engine) is the per-row block
+        table threading the shared arena; None is the dense cache path.
 
         Emits ONE packed [T, S] int32 block: the sampled token where the row
         was live that step, -1 otherwise. Packing matters: every fetched
@@ -507,7 +573,8 @@ class BatchingDecoder:
         is unambiguous — PAD_ID 0 is a legal vocab id)."""
 
         def one(s, _):
-            logits, cache = self._apply_step(variables, s.cache, s.tok, s.pos)
+            logits, cache = self._apply_step(variables, s.cache, s.tok, s.pos,
+                                             pages=pages)
             use, nxt_keys = _split_rows(s.keys)
             nxt = _sample_rows(logits, use, s.temp, s.topk, active=s.live)
             was_live = s.live
@@ -594,11 +661,14 @@ class BatchingDecoder:
         return slab2, packed
 
     def _init_slab_impl(self) -> _Slab:
-        S = self.slots
         # shape-only: densify abstractly so quantized trees never
         # materialize a dense copy just to size the cache
         dense_abstract = jax.eval_shape(self._dense_vars, self._variables)
-        cache = init_cache(self.module, dense_abstract, S)
+        return self._slab_from_cache(
+            init_cache(self.module, dense_abstract, self.slots))
+
+    def _slab_from_cache(self, cache) -> _Slab:
+        S = self.slots
         return _Slab(
             cache,
             jnp.zeros((S,), jnp.int32),
@@ -668,6 +738,7 @@ class BatchingDecoder:
                 raise KubeMLError(
                     f"prompt ({plen}) + max_new_tokens ({req.max_new_tokens})"
                     f" - 1 exceeds the model's max_len ({self.max_len})", 400)
+            self._check_capacity(plen, req.max_new_tokens)
         base_key = (jax.random.PRNGKey(req.seed) if req.seed is not None
                     else None)
         from ..utils import resilience, tracing
@@ -725,6 +796,11 @@ class BatchingDecoder:
 
     def _next_request_id(self) -> str:
         return f"{self._req_prefix}-r{next(self._req_seq)}"
+
+    def _check_capacity(self, plen: int, max_new: int) -> None:
+        """Engine-specific admission-capacity validation hook (400s a row no
+        amount of queueing could ever admit — the paged engine bounds rows
+        by its page arena, the dense engine only by max_len above)."""
 
     # first-traffic XLA compiles (slab init + prefill/admit + step chunk) can
     # take minutes on chip; client-derived timeouts must not punish them
@@ -1005,43 +1081,10 @@ class BatchingDecoder:
             self._fail_all(e)
             return
 
-        fetch_q: queue.Queue = queue.Queue()
-        done: Dict[int, tuple] = {}
-        done_cv = threading.Condition()
-
-        def fetcher():
-            while True:
-                item = fetch_q.get()
-                if item is None:
-                    return
-                seq, rec = item
-                # pool observability: in-flight count + cumulative busy
-                # seconds (kubeml_serving_fetch* — the fetch pipeline is
-                # the binding constraint on tunneled hosts, SERVING_R5_NOTE)
-                self.stats.fetch_started()
-                t0 = time.monotonic()
-                try:
-                    out = self._materialize(rec)
-                except Exception as e:  # surfaces on the engine thread
-                    out = ("error", e)
-                finally:
-                    self.stats.fetch_finished(time.monotonic() - t0)
-                with done_cv:
-                    done[seq] = out
-                    done_cv.notify_all()
-
-        fetchers = [threading.Thread(target=fetcher, daemon=True,
-                                     name=f"decode-fetch-{self.name}-{i}")
-                    for i in range(self.fetchers)]
-        for t in fetchers:
-            t.start()
+        pool = _FetchPool(self, self.fetchers)
         next_seq = 0       # next dispatch sequence number
         process_seq = 0    # next result to consume (in dispatch order)
         self._steps_ahead = [0] * self.slots
-
-        def stop_fetchers():
-            for _ in fetchers:
-                fetch_q.put(None)
 
         while True:
             # deadline hygiene before admission: expired queued work fails
@@ -1052,11 +1095,11 @@ class BatchingDecoder:
                        and not self._busy() and process_seq == next_seq):
                     if self._retired:
                         self._slab = None  # free the KV slab's HBM
-                        stop_fetchers()
+                        pool.stop()
                         return
                     self._cond.wait()
                 if self._closed:
-                    stop_fetchers()
+                    pool.stop()
                     return
                 admits = []
                 if next_seq - process_seq < self.pipeline_depth:
@@ -1083,55 +1126,70 @@ class BatchingDecoder:
                                 self._free.insert(0, slot)
                                 self._pending.appendleft(row)
                         break
-                    fetch_q.put((next_seq, self._dispatch_admits(group)))
+                    pool.submit(next_seq, self._dispatch_admits(group))
                     next_seq += 1
                     dispatched = True
                 self._evict_canceled()
                 self._free_drained_slots()
                 if (next_seq - process_seq < self.pipeline_depth
                         and (needed := self._chunk_wanted()) > 0):
-                    fetch_q.put((next_seq, self._dispatch_chunk(needed)))
+                    pool.submit(next_seq, self._dispatch_chunk(needed))
                     next_seq += 1
                     dispatched = True
                 # consume materialized results in order; block only when the
                 # pipe is full or nothing else can make progress
                 must_wait = (next_seq - process_seq >= self.pipeline_depth
                              or (not dispatched and process_seq < next_seq))
-                while process_seq < next_seq:
-                    with done_cv:
-                        if process_seq not in done:
-                            if not must_wait:
-                                break
-                            done_cv.wait(timeout=1.0)
-                            continue
-                        rec = done.pop(process_seq)
-                    if rec[0] == "error":
-                        raise rec[1]
-                    self._process_record(rec)
-                    process_seq += 1
-                    must_wait = False  # one result is progress enough
+                process_seq = self._consume_ready(pool, process_seq,
+                                                  next_seq, must_wait)
             except Exception as e:
                 log.exception("%s: decode loop failed", self.name)
                 # drain whatever the fetchers still owe so seqs stay aligned
-                with done_cv:
-                    done.clear()
+                pool.clear()
                 process_seq = next_seq
                 self._fail_all(e)
                 with self._cond:
                     if self._closed:
-                        stop_fetchers()
+                        pool.stop()
                         return
                     # reset device state so later traffic gets a clean slab
                     self._slot_rows = [None] * self.slots
                     self._free = list(range(self.slots))
                     self._steps_ahead = [0] * self.slots
                 try:
+                    self._reset_engine_state()
                     self._slab = self._init_slab()
                 except Exception:
                     with self._cond:
                         self._closed = True
-                    stop_fetchers()
+                    pool.stop()
                     return
+
+    def _reset_engine_state(self) -> None:
+        """Fault-recovery hook: extra engine state to rebuild before a fresh
+        slab is initialized (the paged engine rebuilds its page pool here —
+        a zeroed arena invalidates every cached page)."""
+
+    def _consume_ready(self, pool: _FetchPool, process_seq: int,
+                       next_seq: int, must_wait: bool) -> int:
+        """Consume materialized results in dispatch order; blocks only while
+        ``must_wait`` (pipe full, or nothing else can make progress) and
+        returns the advanced ``process_seq``. A fetch error re-raises on the
+        engine thread."""
+        while process_seq < next_seq:
+            with pool.cv:
+                if process_seq not in pool.done:
+                    if not must_wait:
+                        break
+                    pool.cv.wait(timeout=1.0)
+                    continue
+                rec = pool.done.pop(process_seq)
+            if rec[0] == "error":
+                raise rec[1]
+            self._process_record(rec)
+            process_seq += 1
+            must_wait = False  # one result is progress enough
+        return process_seq
 
     def _remaining_steps(self) -> List[int]:
         """Per-active-row steps still needed beyond the dispatch chain (one
@@ -1298,10 +1356,28 @@ class BatchingDecoder:
         resident = [s for s, r in enumerate(snapshot) if r is not None]
         dead_steps = int((~emitted_mask[:, resident]).sum()) if resident else 0
         T, S = packed.shape
+        # capacity travels per chunk (the paged engine's program width is
+        # decoupled from the dense engine's slot count): the partition
+        # identity live + dead + idle == steps x capacity holds either way
         self.stats.chunk_occupancy(
-            T, live_steps, dead_steps, T * S - live_steps - dead_steps)
+            T, live_steps, dead_steps, T * S - live_steps - dead_steps,
+            capacity=S)
         for slot, row in enumerate(snapshot):
-            if row is None or row.done:
+            if row is None:
+                continue
+            if row.done:
+                # the device computed tokens for a row whose waiter is
+                # already gone (canceled/evicted after this chunk was
+                # dispatched): they route nowhere, but they're real device
+                # work — account them as wasted so goodput + wasted stays
+                # the exact partition of every emitted token
+                n = 0
+                for t in range(packed.shape[0]):
+                    if packed[t, slot] < 0:
+                        break
+                    n += 1
+                if n:
+                    self.stats.emitted(n, wasted=True)
                 continue
             fresh: List[int] = []
             for t in range(packed.shape[0]):
@@ -1354,6 +1430,11 @@ class BatchingDecoder:
 
     def _complete_row(self, slot: int, row: _Row) -> None:
         row.done = True
+        self._observe_completion_phases(row)
+        self._release_row_slot(slot, row)
+        self._finish_entry(row.entry)
+
+    def _observe_completion_phases(self, row: _Row) -> None:
         now = time.monotonic()
         if row.first_emit_at:
             # lifecycle: first token -> the row's last emitted token
@@ -1366,6 +1447,8 @@ class BatchingDecoder:
         self.stats.phase("slot_idle",
                          0.0 if row.drained or not row.last_emit_at
                          else now - row.last_emit_at)
+
+    def _release_row_slot(self, slot: int, row: _Row) -> None:
         if row.drained:
             # the slot was pre-freed at dispatch time and may already hold
             # a newly admitted row — only retire the drain bookkeeping.
@@ -1379,7 +1462,8 @@ class BatchingDecoder:
             self._slot_rows[slot] = None
             with self._cond:
                 self._free.append(slot)
-        entry = row.entry
+
+    def _finish_entry(self, entry: _Entry) -> None:
         if entry.finished():
             if self._record_outcome(entry):
                 self.stats.completed(time.monotonic() - entry.submitted_at)
@@ -1426,3 +1510,422 @@ class BatchingDecoder:
             entry.done_evt.set()
             if entry.stream_q is not None:
                 entry.stream_q.put(None)
+
+
+class PagedBatchingDecoder(BatchingDecoder):
+    """The paged KV-cache serving engine: continuous batching with a block
+    allocator, per-token admission, and shared-prefix reuse.
+
+    Where :class:`BatchingDecoder` gives every row a full ``[max_len, H, D]``
+    cache stripe, this engine carves the device KV arena into fixed-size
+    pages (``KUBEML_SERVING_PAGE_TOKENS``) addressed through per-row page
+    tables (serving/kvpool.py), so a row holds memory proportional to what
+    it actually decodes and the admission test is a PAGE BUDGET, not a slot
+    count. ``slots`` here is only the step program's static row width (the
+    compile shape); rows of any length share the one jitted step program
+    via gather/scatter page indexing in the model's paged attention path.
+
+    Three structural differences from the slot engine:
+
+    * **Per-token admission** — chunks are sized down a pow2 ladder to end
+      exactly at the earliest row completion, the finished row's program
+      row and pages free AT DISPATCH TIME (its remaining emissions are all
+      in the ordered dispatch chain, so reuse is race-free — what the slot
+      engine bolted on as the pre-free hack is the admission design here,
+      with exact per-row ``dispatched`` accounting replacing the
+      ``_steps_ahead`` compensation), and the next queued request admits at
+      the very next chunk edge. On a no-EOS workload dead slot-steps are
+      ZERO by construction — the regression test holds the engine to it.
+    * **Shared-prefix reuse** — full prompt-token blocks are cached in a
+      refcounted prefix trie; an identical system prompt / few-shot header
+      maps to the same physical pages, prefill runs ONLY on the unshared
+      suffix, and the request payload reports ``prefix_cached_tokens``.
+    * **Page-budget overload truth** — a request that could never fit the
+      arena 400s at submit; one that merely can't fit NOW queues at the
+      head of the line until pages free (or its deadline expires).
+
+    Quantized weights (int8 / native int8 matmul) compose unchanged — the
+    arena is cache state, not weights. A mesh does not: sharded serving
+    stays on the dense engine until the arena learns a head-sharded layout.
+    """
+
+    def __init__(self, module, variables, *, page_tokens: Optional[int] = None,
+                 pages: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None, mesh=None, **kw):
+        if mesh is not None:
+            raise ValueError(
+                "paged serving does not run on a mesh yet; use the dense "
+                "BatchingDecoder for sharded serving")
+        from ..models.generation import supports_paged_decode
+
+        if not supports_paged_decode(module):
+            raise GenerationInputError(
+                "module has no paged decode path (pages/seq_lens decode "
+                "kwargs + page_tokens/kv_pages fields); serve it through "
+                "the dense BatchingDecoder")
+        cap = getattr(module, "max_len", None)
+        if cap is None:
+            raise GenerationInputError(
+                "model exposes no max_len attribute; batched decode requires "
+                "a declared KV-cache capacity")
+        from ..api.config import get_config
+
+        from .kvpool import KVPool
+
+        cfg = get_config()
+        pt = int(page_tokens if page_tokens is not None
+                 else cfg.serving_page_tokens)
+        slots = int(kw.get("slots", DEFAULT_SLOTS))
+        self.page_tokens = pt
+        # per-row logical table width: enough pages to address max_len
+        self.table_pages = -(-int(cap) // pt)
+        npages = int(pages if pages is not None else cfg.serving_pages)
+        if npages <= 0:
+            # default arena matches the slot engine's worst case (every
+            # program row at full depth) plus the reserved trash page —
+            # never admission-regresses vs slot mode; size it DOWN via
+            # KUBEML_SERVING_PAGES for the memory win
+            npages = slots * self.table_pages + 1
+        use_trie = bool(prefix_cache if prefix_cache is not None
+                        else cfg.serving_prefix_cache)
+        self._pool = KVPool(npages, pt, prefix_cache=use_trie)
+        # the arena dims ride the module as clone fields so the flax cache
+        # variables know their shapes (params are untouched by the clone)
+        module = module.clone(page_tokens=pt, kv_pages=npages)
+        super().__init__(module, variables, mesh=None, **kw)
+        # pow2 chunk ladder: any remaining-step count decomposes into
+        # ladder chunks, so chunks end EXACTLY at the earliest completion
+        # (the per-token admission edge) with a bounded program set —
+        # log2(chunk_steps) compiles, not one per request length
+        import functools
+
+        ladder = {self.chunk_steps}
+        t = 1
+        while t < self.chunk_steps:
+            ladder.add(t)
+            t *= 2
+        self._chunk_sizes = sorted(ladder)
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        self._steps = {
+            T: jax.jit(functools.partial(self._step_impl, steps=T),
+                       donate_argnums=donate)
+            for T in self._chunk_sizes
+        }
+        # host page-table mirror handed to every dispatch ([slots, P] i32);
+        # zeroed rows point at the trash page, so a retired/canceled row's
+        # stale device writes can never reach a reallocated page
+        self._table = np.zeros((self.slots, self.table_pages), np.int32)
+
+    # --- capacity & programs ---
+
+    def _check_capacity(self, plen: int, max_new: int) -> None:
+        if not self._pool.can_admit(plen, max_new):
+            raise KubeMLError(
+                f"request needs {self._pool.pages_for(plen + max_new - 1)} "
+                f"KV pages but the arena holds {self._pool.capacity} "
+                f"(KUBEML_SERVING_PAGES x KUBEML_SERVING_PAGE_TOKENS)", 400)
+
+    def _init_slab_impl(self) -> _Slab:
+        from ..models.generation import init_paged_cache
+
+        dense_abstract = jax.eval_shape(self._dense_vars, self._variables)
+        return self._slab_from_cache(init_paged_cache(
+            self.module, dense_abstract, self.slots, self.table_pages))
+
+    def _prefill_admit_impl(self, variables, slab, ptbl, suffix, base, slens,
+                            rowids, max_news, temps, topks, eoss, keys):
+        """ONE program per (suffix-length bucket): prefill k UNSHARED
+        suffixes together straight into the paged arena (a prefix hit's
+        cached pages are already there — only the suffix runs, the FLOP
+        saving behind kubeml_serving_prefix_tokens_saved_total), scatter
+        each row's cursors/knobs into its program row, and sample first
+        tokens. Row-count padding repeats the last row (identical pages,
+        identical bytes — idempotent scatter), exactly like the dense
+        engine's admit."""
+        variables = self._dense_vars(variables)
+        logits, vs = self.module.apply(
+            {**variables, "cache": slab.cache}, suffix, decode=True,
+            positions=base, pages=ptbl, seq_lens=slens, mutable=["cache"])
+        cache = vs["cache"]
+        last = jnp.take_along_axis(
+            logits, (slens - 1)[:, None, None], axis=1)[:, 0].astype(
+                jnp.float32)
+        use, nxt_keys = _split_rows(keys)
+        firsts = _sample_rows(last, use, temps, topks)
+        hit_eos = (eoss >= 0) & (firsts == eoss)
+        live0 = (max_news > 1) & ~hit_eos
+
+        def put(vec, vals):
+            return vec.at[rowids].set(vals.astype(vec.dtype))
+
+        slab2 = _Slab(
+            cache,
+            put(slab.tok, firsts),
+            put(slab.pos, base + slens),
+            put(slab.live, live0),
+            put(slab.remaining, max_news - 1),
+            slab.keys.at[rowids].set(nxt_keys),
+            put(slab.temp, temps),
+            put(slab.topk, topks),
+            put(slab.eos, eoss),
+        )
+        packed = jnp.stack([firsts, live0.astype(jnp.int32)], axis=1)
+        return slab2, packed
+
+    # --- admission (engine thread; caller holds self._cond) ---
+
+    def _take_admissions_locked(self, max_n: int) -> List[tuple]:
+        """Admit queued rows in FIFO order while a program row is free AND
+        the page budget covers them (worst-case reservation: prompt +
+        max_new-1 positions, minus whatever the prefix trie already
+        caches). The head of the line blocks the tail — admission stays
+        fair, and a starved head admits the moment pages free at a chunk
+        edge. ``max_n`` bounds the dispatches one iteration may create so
+        the pipeline gate never has to un-admit a leased row."""
+        admits = []
+        while len(admits) < max_n and self._pending and self._free:
+            row = self._pending[0]
+            if row.canceled:
+                self._pending.popleft()
+                continue
+            lease = self._pool.admit(row.prompt, row.max_new)
+            if lease is None:
+                break
+            self._pending.popleft()
+            slot = self._free.pop(0)
+            row.lease = lease
+            row.prefix_cached = lease.prefix_tokens
+            if lease.shared:
+                self.stats.prefix_hit(lease.prefix_tokens)
+            admits.append((slot, row))
+        return admits
+
+    def _group_admits(self, admits: List[tuple]) -> List[List[tuple]]:
+        """Group by UNSHARED-SUFFIX length bucket (the prefill program's
+        shape) — a prefix hit's bucket shrinks with its suffix."""
+        by_bucket: Dict[int, List[tuple]] = {}
+        for slot, row in admits:
+            sfx = max(len(row.prompt) - row.lease.prefix_tokens, 1)
+            b = _pow2_bucket(sfx, self.bucket_min, self.max_len)
+            by_bucket.setdefault(b, []).append((slot, row))
+        return list(by_bucket.values())
+
+    def _dispatch_admits(self, group: List[tuple]) -> tuple:
+        n = len(group)
+        k = self.slots
+        bucket = _pow2_bucket(
+            max(max(len(r.prompt) - r.lease.prefix_tokens for _, r in group),
+                1), self.bucket_min, self.max_len)
+        padded_group = group + [group[-1]] * (k - n)
+        suffix = np.zeros((k, bucket), np.int32)
+        base = np.zeros((k,), np.int32)
+        slens = np.ones((k,), np.int32)
+        rowids = np.zeros((k,), np.int32)
+        max_news = np.zeros((k,), np.int32)
+        temps = np.zeros((k,), np.float32)
+        topks = np.zeros((k,), np.int32)
+        eoss = np.zeros((k,), np.int32)
+        keys = np.zeros((k, 2), np.uint32)
+        ptbl = np.zeros((k, self.table_pages), np.int32)
+        for i, (slot, row) in enumerate(padded_group):
+            pre = row.lease.prefix_tokens
+            sfx = row.prompt[pre:]
+            suffix[i, :len(sfx)] = sfx
+            base[i] = pre
+            slens[i] = len(sfx)
+            rowids[i] = slot
+            ptbl[i, :len(row.lease.pages)] = row.lease.pages
+            max_news[i] = row.max_new
+            temps[i] = row.temp
+            topks[i] = row.topk
+            eoss[i] = row.eos
+            keys[i] = row.key
+        self._slab, packed = self._prefill_admit(
+            self._variables, self._slab, jnp.asarray(ptbl),
+            jnp.asarray(suffix), jnp.asarray(base), jnp.asarray(slens),
+            jnp.asarray(rowids), jnp.asarray(max_news), jnp.asarray(temps),
+            jnp.asarray(topks), jnp.asarray(eoss), jnp.asarray(keys))
+        now = time.monotonic()
+        real_tokens = 0
+        for slot, row in group:
+            self._slot_rows[slot] = row
+            self._table[slot, :] = 0
+            self._table[slot, :len(row.lease.pages)] = row.lease.pages
+            row.dispatched = 0
+            row.slot_at = now
+            self.stats.phase("queue_wait", now - row.entry.submitted_at)
+            real_tokens += len(row.prompt) - row.lease.prefix_tokens
+            # cache the FULL prompt blocks for future sharers. At dispatch
+            # time, not admission: device programs run in dispatch order,
+            # so a later match is guaranteed to read pages already written
+            self._pool.register_prefix(row.prompt, row.lease)
+        self.stats.admitted_wave()
+        # prefill accounting: only the unshared suffixes are computed —
+        # prefix-cached tokens are the measured FLOP saving, padding is the
+        # bucket + repeated-row compute
+        self.stats.admit_tokens(real_tokens, k * bucket - real_tokens)
+        return ("admit", group, packed)
+
+    # --- the decode chunk (pow2 ladder to the earliest completion) ---
+
+    def _paged_chunk_size(self) -> int:
+        rem = [row.max_new - 1 - row.dispatched
+               for row in self._slot_rows
+               if row is not None and not row.done and not row.canceled
+               and row.max_new - 1 - row.dispatched > 0]
+        if not rem:
+            return 0
+        soonest = min(rem)
+        size = self._chunk_sizes[0]
+        for t in self._chunk_sizes:
+            if t <= soonest:
+                size = t
+        return size
+
+    def _dispatch_chunk_paged(self, size: int) -> tuple:
+        # the table ships as a COPY: jnp.asarray of a numpy array can be
+        # zero-copy on CPU, and the host mutates self._table in place the
+        # moment a row retires (often right after dispatching its dying
+        # chunk) — an aliased buffer would hand the still-executing program
+        # a zeroed table row and trash-redirect the row's final tokens
+        self._slab, packed = self._steps[size](
+            self._variables, self._slab, jnp.asarray(self._table.copy()))
+        for row in self._slot_rows:
+            if row is not None and not row.done and not row.canceled:
+                row.dispatched += size
+        self.stats.chunk()
+        return ("chunk", packed, list(self._slot_rows))
+
+    def _retire_dispatched(self) -> None:
+        """Per-token admission's other half: a row whose every remaining
+        emission is already in the ordered dispatch chain releases its
+        program row AND its pages NOW — any reuse is dispatched after, so
+        the device-order dependency makes the handoff race-free. Tokens
+        still in flight route through per-dispatch snapshots; the row waits
+        in ``_draining`` only for its waiter bookkeeping."""
+        for slot, row in enumerate(self._slot_rows):
+            if row is None or row.done or row.canceled:
+                continue
+            if row.dispatched >= row.max_new - 1:
+                row.drained = True
+                self._slot_rows[slot] = None
+                self._table[slot, :] = 0
+                self._pool.release(row.lease)
+                with self._cond:
+                    self._draining.append(row)
+                    self._free.append(slot)
+
+    def _evict_canceled(self) -> None:
+        for slot, row in enumerate(self._slot_rows):
+            if row is not None and row.canceled:
+                self._slab.live = self._slab.live.at[slot].set(False)
+                row.done = True
+                self._slot_rows[slot] = None
+                self._table[slot, :] = 0
+                self._pool.release(row.lease)
+                with self._cond:
+                    self._free.append(slot)
+
+    def _release_row_slot(self, slot: int, row: _Row) -> None:
+        if row.lease is not None:
+            self._pool.release(row.lease)  # idempotent per lease
+        if row.drained:
+            with self._cond:
+                self._draining = [r for r in self._draining if r is not row]
+        else:
+            self._slot_rows[slot] = None
+            self._table[slot, :] = 0
+            with self._cond:
+                self._free.append(slot)
+
+    def _reset_engine_state(self) -> None:
+        """Fault recovery: a rebuilt slab means a ZEROED arena, so every
+        cached page (and the trie over them) is invalid — fresh pool."""
+        from .kvpool import KVPool
+
+        self._pool = KVPool(self._pool.num_pages, self.page_tokens,
+                            prefix_cache=self._pool.trie is not None)
+        self._table[:] = 0
+
+    def telemetry(self) -> dict:
+        snap = super().telemetry()
+        snap.update(self._pool.telemetry())
+        return snap
+
+    # --- the engine loop (paged flavor) ---
+
+    def _loop(self) -> None:
+        try:
+            self._slab = self._init_slab()
+        except Exception as e:
+            log.exception("%s: paged slab init failed", self.name)
+            with self._cond:
+                self._closed = True
+            self._fail_all(e)
+            return
+        pool = _FetchPool(self, self.fetchers)
+        next_seq = 0
+        process_seq = 0
+        while True:
+            self._sweep_expired()
+            with self._cond:
+                while (not self._closed and not self._pending
+                       and not self._busy() and process_seq == next_seq):
+                    if self._retired:
+                        self._slab = None  # free the arena's HBM
+                        pool.stop()
+                        return
+                    self._cond.wait()
+                if self._closed:
+                    pool.stop()
+                    return
+                room = self.pipeline_depth - (next_seq - process_seq)
+                admits = (self._take_admissions_locked(room)
+                          if room > 0 else [])
+            try:
+                dispatched = False
+                live_admits = []
+                for slot, row in admits:
+                    if row.canceled:  # canceled between admit and dispatch
+                        self._pool.release(row.lease)
+                        with self._cond:
+                            self._free.append(slot)
+                        continue
+                    live_admits.append((slot, row))
+                for group in self._group_admits(live_admits):
+                    pool.submit(next_seq, self._dispatch_admits(group))
+                    next_seq += 1
+                    dispatched = True
+                self._evict_canceled()
+                self._retire_dispatched()
+                if (next_seq - process_seq < self.pipeline_depth
+                        and (size := self._paged_chunk_size()) > 0):
+                    pool.submit(next_seq, self._dispatch_chunk_paged(size))
+                    next_seq += 1
+                    dispatched = True
+                    # the chunk may have fully dispatched rows: free their
+                    # program rows + pages for the NEXT chunk edge
+                    self._retire_dispatched()
+                must_wait = (next_seq - process_seq >= self.pipeline_depth
+                             or (not dispatched and process_seq < next_seq))
+                process_seq = self._consume_ready(pool, process_seq,
+                                                  next_seq, must_wait)
+            except Exception as e:
+                log.exception("%s: paged decode loop failed", self.name)
+                pool.clear()
+                process_seq = next_seq
+                self._fail_all(e)
+                with self._cond:
+                    if self._closed:
+                        pool.stop()
+                        return
+                    self._slot_rows = [None] * self.slots
+                    self._free = list(range(self.slots))
+                try:
+                    self._reset_engine_state()
+                    self._slab = self._init_slab()
+                except Exception:
+                    with self._cond:
+                        self._closed = True
+                    pool.stop()
+                    return

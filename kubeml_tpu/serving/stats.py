@@ -83,6 +83,11 @@ class DecoderStats:
         self.prefill_pad_tokens = 0   # bucket + row padding tokens computed
         self.goodput_tokens = 0       # tokens delivered to a live waiter
         self.wasted_tokens = 0        # tokens routed to an aborted request
+        # shared-prefix reuse (paged engine, serving/kvpool.py): admissions
+        # whose leading prompt blocks came from the prefix trie, and the
+        # prompt tokens those cached pages covered (prefill skipped them)
+        self.prefix_hits = 0
+        self.prefix_tokens_saved = 0
         # fetcher pool (results/SERVING_R5_NOTE.md: short-request workloads
         # are fetch-pipeline-bound on tunneled hosts): completed fetches,
         # cumulative blocked wall seconds (rate/pool = utilization), live
@@ -137,14 +142,19 @@ class DecoderStats:
             self.chunks += 1
 
     def chunk_occupancy(self, steps: int, live: int, dead: int,
-                        idle: int) -> None:
+                        idle: int, capacity: Optional[int] = None) -> None:
         """Per-device-step slot accounting for one processed chunk:
-        ``steps`` decode steps over ``slots`` slots split into live (token
-        emitted), dead (resident row, nothing emitted — the dead-step waste
-        SERVING_R5 had to reason about blind) and idle (no row) slot-steps."""
+        ``steps`` decode steps over ``capacity`` resident rows (the chunk
+        program's own width — the paged engine's page-indexed row count is
+        decoupled from the dense engine's slot count, so capacity travels
+        per call; None keeps the constructor's slot count) split into live
+        (token emitted), dead (resident row, nothing emitted — the
+        dead-step waste SERVING_R5 had to reason about blind) and idle (no
+        row) slot-steps. The partition identity live + dead + idle ==
+        steps x capacity holds for every call regardless of capacity."""
         if steps <= 0:
             return
-        total = steps * self.slots
+        total = steps * (capacity if capacity is not None else self.slots)
         with self._lock:
             self.device_steps += int(steps)
             self.slot_steps += total
@@ -152,6 +162,13 @@ class DecoderStats:
             self.dead_slot_steps += int(dead)
             self.idle_slot_steps += int(idle)
             self._hist_occupancy.observe(live / total if total else 0.0)
+
+    def prefix_hit(self, tokens_saved: int) -> None:
+        """One admission served partly from the shared-prefix cache:
+        ``tokens_saved`` prompt tokens' prefill was skipped entirely."""
+        with self._lock:
+            self.prefix_hits += 1
+            self.prefix_tokens_saved += int(tokens_saved)
 
     def admit_tokens(self, real: int, padding: int) -> None:
         """Prefill token accounting for one admission program: ``real``
@@ -297,6 +314,8 @@ class DecoderStats:
                 "prefill_pad_tokens": float(self.prefill_pad_tokens),
                 "goodput_tokens": float(self.goodput_tokens),
                 "wasted_tokens": float(self.wasted_tokens),
+                "prefix_hits": float(self.prefix_hits),
+                "prefix_tokens_saved": float(self.prefix_tokens_saved),
                 # lifetime useful fraction of raw device slot-step capacity
                 "goodput_ratio": (self.live_slot_steps / self.slot_steps
                                   if self.slot_steps else 0.0),

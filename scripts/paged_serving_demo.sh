@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Paged KV-cache serving proof (CPU-measurable, no chip needed): drive one
+# mixed-length chat-shaped workload through the dense slot engine and the
+# paged engine (same program width, and double-width at the same KV memory
+# budget), appending the A/B rows to results/paged_serving.jsonl.
+#
+#   scripts/paged_serving_demo.sh [--seed N] [--requests N] [--slots N]
+#                                 [--page-tokens N] [--chunk-steps N]
+#
+# The gate row (ISSUE 12 acceptance) requires, on the same traffic:
+#   a. paged batch_occupancy_ratio > slot, paged dead slot-steps < slot,
+#      and paged-at-the-slot-memory-budget wasted_tokens <= slot;
+#   b. prefix-cache hits with recorded prefill savings (shared system
+#      prompt -> prefix_tokens_saved, lower real prefill token count);
+#   c. token parity at fixed seed, slot vs paged (greedy AND sampled rows).
+# Exit status mirrors the gate.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p results
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m kubeml_tpu.benchmarks.paged_serving \
+    --out results/paged_serving.jsonl "$@"
