@@ -207,6 +207,21 @@ class Config:
         default_factory=lambda: float(os.environ.get("KUBEML_TOP_WINDOW", "30"))
     )
 
+    # --- elastic-training decision observability (scheduler/decisions.py +
+    # engine/kavg.py round statistics) ---
+    # scale-decision audit trail retention: newest decisions kept per job,
+    # and distinct jobs kept (oldest-recorded job evicted past the cap)
+    decision_log_size: int = field(
+        default_factory=lambda: _env_int("KUBEML_DECISION_LOG_SIZE", 64))
+    decision_log_jobs: int = field(
+        default_factory=lambda: _env_int("KUBEML_DECISION_LOG_JOBS", 256))
+    # statistical-efficiency signals from the K-AVG round program: per-round
+    # worker-loss spread and pre-merge weight divergence, computed as cheap
+    # on-chip reductions inside the jitted sync round. KUBEML_ROUND_STATS=0
+    # restores the exact pre-instrumentation round program (bit-identical).
+    round_stats: bool = field(
+        default_factory=lambda: _env_bool("KUBEML_ROUND_STATS", True))
+
     # --- function execution guardrails (reference cmd/function.go:234-262:
     # per-function concurrency 50, execution timeout 1000s) ---
     # seconds a user-code call (function load, traced user module, a job

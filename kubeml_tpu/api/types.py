@@ -383,6 +383,11 @@ class MetricUpdate(_JsonMixin):
     train_loss: float = 0.0
     parallelism: int = 0
     epoch_duration: float = 0.0
+    # 1-based count of epochs COMPLETED, from the job's own loop counter —
+    # correct across resume/preemption, unlike counting pushes at the PS
+    # (a resumed job's first push may be epoch 5). -1 = not reported (an
+    # engine predating the field); the PS then falls back to counting.
+    epoch: int = -1
     # MoE expert-capacity overflow rate of the last epoch's steps (fraction
     # of attempted top-k assignments dropped by the capacity limit);
     # -1 = the model has no MoE layers (gauge omitted)
@@ -395,6 +400,18 @@ class MetricUpdate(_JsonMixin):
     # -1 = not measured (e.g. an engine that doesn't time it)
     round_seconds: List[float] = field(default_factory=list)
     merge_seconds: float = -1.0
+    # statistical-efficiency signals from the K-AVG round program
+    # (engine/kavg.py, KUBEML_ROUND_STATS): per-round pre-merge weight
+    # divergence (Frobenius norm of the stacked worker vars minus their
+    # participant mean, normalized by the mean's norm — the quantity local
+    # SGD degrades as K/parallelism grow) and per-round worker-loss spread
+    # (max - min over effective participants). Empty = not measured
+    # (instrumentation off, or an engine without local-SGD rounds).
+    round_divergence: List[float] = field(default_factory=list)
+    round_loss_spread: List[float] = field(default_factory=list)
+    # per-epoch straggler signal: max/median over this epoch's
+    # round_seconds (>= 1.0 when measured; -1 = fewer than 2 rounds)
+    round_skew_ratio: float = -1.0
     # data-plane counter deltas riding the epoch push as SEQUENCED batches
     # ([{"seq": n, "phases": {phase: {bytes, seconds, events}}}, ...]):
     # standalone runners expose no scraped /metrics route, so their
@@ -419,9 +436,42 @@ class History(_JsonMixin):
     train_loss: List[float] = field(default_factory=list)
     parallelism: List[int] = field(default_factory=list)
     epoch_duration: List[float] = field(default_factory=list)
+    # statistical-efficiency record per epoch (K-AVG engine with
+    # KUBEML_ROUND_STATS on; empty otherwise): mean pre-merge worker
+    # divergence, mean worker-loss spread, and the round-time skew ratio
+    # (max/median) of the epoch's rounds. With instrumentation on, the
+    # lists stay index-aligned with train_loss/parallelism — an epoch
+    # that measured nothing (e.g. every round lost its participants, or a
+    # single round for skew) records NaN, never a silent skip
+    worker_divergence: List[float] = field(default_factory=list)
+    loss_spread: List[float] = field(default_factory=list)
+    round_skew: List[float] = field(default_factory=list)
     # operational notes surfaced to the user (e.g. requested parallelism
     # rounded to a host-count multiple); absent in reference histories
     notes: List[str] = field(default_factory=list)
+
+    # the signal lists' unmeasured-epoch placeholder is NaN in memory but
+    # must cross the wire as JSON null: bare `NaN` tokens are RFC-invalid
+    # and break jq / JSON.parse / Grafana on the whole /history payload
+    _SIGNAL_LISTS = ("worker_divergence", "loss_spread", "round_skew")
+
+    def __post_init__(self):
+        import math
+
+        for name in self._SIGNAL_LISTS:
+            vals = getattr(self, name)
+            if any(v is None for v in vals):
+                setattr(self, name,
+                        [math.nan if v is None else float(v) for v in vals])
+
+    def to_dict(self) -> Dict[str, Any]:
+        import math
+
+        d = super().to_dict()
+        for name in self._SIGNAL_LISTS:
+            d[name] = [None if isinstance(v, float) and math.isnan(v) else v
+                       for v in d[name]]
+        return d
 
     def append_epoch(
         self,
@@ -430,6 +480,9 @@ class History(_JsonMixin):
         duration: float,
         validation_loss: Optional[float] = None,
         accuracy: Optional[float] = None,
+        worker_divergence: Optional[float] = None,
+        loss_spread: Optional[float] = None,
+        round_skew: Optional[float] = None,
     ) -> None:
         self.train_loss.append(float(train_loss))
         self.parallelism.append(int(parallelism))
@@ -438,6 +491,12 @@ class History(_JsonMixin):
             self.validation_loss.append(float(validation_loss))
         if accuracy is not None:
             self.accuracy.append(float(accuracy))
+        if worker_divergence is not None:
+            self.worker_divergence.append(float(worker_divergence))
+        if loss_spread is not None:
+            self.loss_spread.append(float(loss_spread))
+        if round_skew is not None:
+            self.round_skew.append(float(round_skew))
 
 
 @dataclass

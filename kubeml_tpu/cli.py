@@ -533,26 +533,85 @@ def cmd_slo(args) -> int:
     return 0
 
 
-# --- top: the live serving view, refreshing from /metrics/history ---
+# --- decisions: the elastic scale-decision audit trail of one job ---
+
+
+def cmd_decisions(args) -> int:
+    """``kubeml decisions <job-id> [--json]``: every retained scale
+    decision of the job — the from->to transition, its direction, the
+    enumerated reason, and the policy inputs (cached epoch time, elapsed,
+    thresholds, cap, limit flag) that produced it. Retention is bounded
+    (KUBEML_DECISION_LOG_SIZE newest per job); ``total`` counts decisions
+    ever recorded."""
+    data = _client(args).tasks().decisions(args.id)
+    if args.json:
+        _print(data)
+        return 0
+    decisions = data.get("decisions", [])
+    if not decisions:
+        print(f"no scale decisions recorded for job {args.id}")
+        return 0
+
+    def num(v, nd=2):
+        return "-" if v is None else f"{v:.{nd}f}"
+
+    cols = ("TIME", "SEQ", "FROM", "TO", "DIR", "REASON", "ELAPSED",
+            "CACHED", "CAP")
+    rows = []
+    for d in decisions:
+        inputs = d.get("inputs", {})
+        rows.append((
+            time.strftime("%H:%M:%S", time.localtime(d.get("t", 0))),
+            str(d.get("seq", "")),
+            str(d.get("from", "")),
+            str(d.get("to", "")),
+            d.get("direction", "?"),
+            d.get("reason", "?"),
+            num(inputs.get("elapsed")),
+            num(inputs.get("cached")),
+            str(inputs.get("cap", "-")),
+        ))
+    _print_table(cols, rows)
+    dropped = data.get("total", len(decisions)) - len(decisions)
+    if dropped > 0:
+        print(f"({dropped} older decision(s) beyond the retention window; "
+              f"raise KUBEML_DECISION_LOG_SIZE to keep more)")
+    return 0
+
+
+# --- top: the live serving + training view, from /metrics/history ---
 
 
 def cmd_top(args) -> int:
-    """``kubeml top [-n N] [--interval S] [--once]``: a live serving-health
-    view — per-model occupancy, paged-KV page occupancy, queue depth,
-    tokens/sec, goodput ratio, TTFT p99 — plus SLO burn rates, refreshing
-    from the embedded time-series store (``/metrics/history``) every
-    ``--interval`` seconds (KUBEML_TOP_INTERVAL)."""
+    """``kubeml top [-n N] [--interval S] [--once]``: a live operator view
+    refreshing from the embedded time-series store (``/metrics/history``)
+    every ``--interval`` seconds (KUBEML_TOP_INTERVAL). Serving rows:
+    per-model occupancy, paged-KV page occupancy, queue depth, tokens/sec,
+    goodput ratio, TTFT p99 — plus SLO burn rates. Training rows: per-job
+    epoch progress, train loss, parallelism, pre-merge worker divergence,
+    loss spread, and round-time skew (the statistical-efficiency signals
+    the elastic scheduler's decisions are judged against)."""
     cfg = get_config()
     client = _client(args)
     interval = args.interval if args.interval else cfg.top_interval
     iterations = 1 if args.once else args.iterations
 
-    def metric(series: dict, name: str, model: str, *fields):
-        entry = series.get(f'{name}{{model="{model}"}}') or {}
+    def labeled(series: dict, name: str, label: str, value: str) -> dict:
+        return series.get(f'{name}{{{label}="{value}"}}') or {}
+
+    def pick(series: dict, name: str, label: str, value: str, *fields):
+        """First present field of one labeled series entry (None = absent)."""
+        entry = labeled(series, name, label, value)
         for f in fields:
             if f in entry:
                 return entry[f]
         return None
+
+    def metric(series: dict, name: str, model: str, *fields):
+        return pick(series, name, "model", model, *fields)
+
+    def jmetric(series: dict, name: str, jid: str, *fields):
+        return pick(series, name, "jobid", jid, *fields)
 
     def fmt(v, nd=2):
         return "-" if v is None else f"{v:.{nd}f}"
@@ -609,6 +668,49 @@ def cmd_top(args) -> int:
             _print_table(cols, rows)
         else:
             print("(no serving traffic sampled yet)")
+        # --- training rows: the per-job gauges the sampler folds into the
+        # tsdb (parallelism + the statistical-efficiency signals). The
+        # ring retains a finished job's last samples, so a LIVE view must
+        # drop rows whose series stopped being fed (last_t went stale) —
+        # otherwise every dead job renders frozen values forever.
+        now_s = hist.get("now") or time.time()
+        stale_after = float(hist.get("stats_window") or cfg.top_window)
+
+        def alive(jid):
+            lt = labeled(series, "kubeml_job_parallelism", "jobid",
+                         jid).get("last_t")
+            return lt is not None and now_s - lt <= stale_after
+
+        jobs = sorted({k.split('jobid="', 1)[1].split('"', 1)[0]
+                       for k in series if 'jobid="' in k})
+        tcols = ("JOB", "EPOCH", "LOSS", "PAR", "DIVERG", "SPREAD", "SKEW",
+                 "EPOCH-S")
+        trows = []
+        for j in jobs:
+            if not alive(j):
+                continue
+            trows.append((
+                j,
+                fmt(jmetric(series, "kubeml_job_epoch", j, "latest"), 0),
+                fmt(jmetric(series, "kubeml_job_train_loss", j,
+                            "latest"), 4),
+                fmt(jmetric(series, "kubeml_job_parallelism", j,
+                            "latest"), 0),
+                # pre-merge worker divergence / loss spread / round skew —
+                # "-" for jobs without round stats (spmd engine, or
+                # KUBEML_ROUND_STATS=0)
+                fmt(jmetric(series, "kubeml_job_worker_divergence", j,
+                            "latest"), 5),
+                fmt(jmetric(series, "kubeml_job_loss_spread", j,
+                            "latest"), 4),
+                fmt(jmetric(series, "kubeml_job_round_skew_ratio", j,
+                            "latest")),
+                fmt(jmetric(series, "kubeml_job_epoch_duration_seconds", j,
+                            "latest")),
+            ))
+        if trows:
+            print("\ntraining:")
+            _print_table(tcols, trows)
         objs = slo.get("objectives", [])
         if objs:
             print("slo: " + "  ".join(
@@ -818,6 +920,13 @@ def build_parser() -> argparse.ArgumentParser:
     j.add_argument("--json", action="store_true", help="raw JSON output")
     j.set_defaults(fn=cmd_jobs)
 
+    dec = sub.add_parser("decisions",
+                         help="a job's elastic scale-decision audit trail "
+                              "(transition, reason, policy inputs)")
+    dec.add_argument("id", help="job id")
+    dec.add_argument("--json", action="store_true", help="raw JSON payload")
+    dec.set_defaults(fn=cmd_decisions)
+
     h = sub.add_parser("history", help="training histories")
     hsub = h.add_subparsers(dest="action", required=True)
     hg = hsub.add_parser("get")
@@ -860,8 +969,9 @@ def build_parser() -> argparse.ArgumentParser:
     sl.set_defaults(fn=cmd_slo)
 
     tp = sub.add_parser("top",
-                        help="live serving view (occupancy, queue, tok/s, "
-                             "burn rates) from /metrics/history")
+                        help="live serving + training view (occupancy, "
+                             "queue, tok/s, burn rates; per-job epoch/loss/"
+                             "parallelism/divergence) from /metrics/history")
     tp.add_argument("-n", "--iterations", type=int, default=0,
                     help="refresh N times then exit (0 = until Ctrl-C)")
     tp.add_argument("--interval", type=float, default=0.0,

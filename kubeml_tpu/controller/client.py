@@ -201,6 +201,14 @@ class _Tasks:
         return _check(requests.get(f"{self.c.url}/jobs",
                                    timeout=requests.timeouts(self.c.timeout)))
 
+    def decisions(self, job_id: str) -> dict:
+        """The job's scale-decision audit trail (`kubeml decisions`):
+        ``{"job_id", "total", "decisions": [{t, seq, from, to, direction,
+        reason, inputs: {cached, elapsed, thresholds, cap, limit}}]}`` —
+        oldest first, bounded retention (KUBEML_DECISION_LOG_SIZE)."""
+        return _check(requests.get(f"{self.c.url}/jobs/{job_id}/decisions",
+                                   timeout=requests.timeouts(self.c.timeout)))
+
     def prune(self) -> int:
         return _check(requests.delete(f"{self.c.url}/tasks", timeout=requests.timeouts(self.c.timeout)))["pruned"]
 

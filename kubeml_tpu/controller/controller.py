@@ -57,6 +57,10 @@ class Controller:
         router.route("DELETE", "/dataset/{name}", self._dataset_delete)
         router.route("GET", "/tasks", self._tasks)
         router.route("GET", "/jobs", self._jobs)
+        # scale-decision audit trail (scheduler proxy): why each elastic
+        # transition of a job happened, with its full policy inputs —
+        # what `kubeml decisions <job-id>` renders
+        router.route("GET", "/jobs/{id}/decisions", self._job_decisions)
         router.route("DELETE", "/tasks", self._task_prune)
         router.route("DELETE", "/tasks/{id}", self._task_stop)
         router.route("POST", "/tasks/{id}/preempt", self._task_preempt)
@@ -149,6 +153,9 @@ class Controller:
         # a requeued job can be both queued AND still journaled; queued wins
         rest = [j for j in self.ps.jobs_snapshot() if j["job_id"] not in seen]
         return queued + rest
+
+    def _job_decisions(self, req: Request):
+        return self.scheduler.job_decisions(req.params["id"])
 
     def _task_stop(self, req: Request):
         self.ps.stop_task(req.params["id"])

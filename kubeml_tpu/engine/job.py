@@ -126,6 +126,13 @@ class TrainJob:
         # with the epoch's MetricUpdate)
         self._last_round_times: list = []
         self._last_merge_s = -1.0
+        # statistical-efficiency signals of the epoch's rounds (trainer
+        # round program, KUBEML_ROUND_STATS): device arrays accumulated
+        # lazily per round, fetched ONCE at the epoch-end loss sync
+        self._epoch_round_stats: list = []
+        self._last_divergence: list = []
+        self._last_spread: list = []
+        self._last_round_skew = -1.0
 
         self.history = History(id=job_id, task={"request": request.to_dict()})
         self.history.notes.extend(self._pending_notes)
@@ -320,10 +327,21 @@ class TrainJob:
                     duration=elapsed,
                     validation_loss=val_loss,
                     accuracy=acc_pct,
+                    # with round stats ON every epoch appends a value — an
+                    # unmeasured epoch (all-NaN rounds, or a single round
+                    # for skew) records NaN so the signal lists stay
+                    # index-aligned with train_loss/parallelism; with stats
+                    # OFF the lists stay empty entirely (None = no append)
+                    worker_divergence=self._epoch_signal(
+                        self._last_divergence),
+                    loss_spread=self._epoch_signal(self._last_spread),
+                    round_skew=(self._last_round_skew
+                                if self._last_round_skew >= 0
+                                else self._epoch_signal(())),
                 )
                 if self._leader:
                     self._push_metrics(train_loss, val_loss, acc_pct, elapsed,
-                                       used_parallelism)
+                                       used_parallelism, epoch + 1)
                 if (opts.checkpoint_every > 0 and not yielding
                         and (epoch + 1) % opts.checkpoint_every == 0):
                     # preempting: redundant with the synchronous yield
@@ -441,6 +459,10 @@ class TrainJob:
         # latency-histogram feeds, reset per epoch (pushed with MetricUpdate)
         self._last_round_times = []
         self._last_merge_s = -1.0
+        self._epoch_round_stats = []
+        self._last_divergence = []
+        self._last_spread = []
+        self._last_round_skew = -1.0
         # prefetched staging (engine/kavg.RoundPrefetcher): each round's
         # slabs are device_put KUBEML_DATAPLANE_PREFETCH rounds ahead
         # (default 1 = double buffering), so the host->HBM transfer of round
@@ -490,6 +512,10 @@ class TrainJob:
             # function/update-latency analog of the reference's per-invocation
             # timing (dispatch is async; sync stalls land on the epoch fetch)
             self._last_round_times.append(time.time() - t_round)
+            # [loss spread, weight divergence] of the dispatched round —
+            # still a device array; fetched with the epoch-end loss sync
+            if self.trainer.last_round_stats is not None:
+                self._epoch_round_stats.append(self.trainer.last_round_stats)
             self.heartbeat = time.time()  # round dispatched: job is alive
             self.heartbeat_cold = False   # cold-start compile is behind us
             if not losses:
@@ -527,6 +553,7 @@ class TrainJob:
             self._last_merge_s = time.time() - t_merge
             self.tracer.record("job.merge", self._last_merge_s,
                                service="worker", job=self.job_id, epoch=epoch)
+            self._finalize_round_stats()
             return mean_loss
         except KubeMLError:
             raise
@@ -613,6 +640,42 @@ class TrainJob:
                 if self.stop_event.wait(1.0 + attempt):
                     return None
 
+    def _epoch_signal(self, values):
+        """Epoch aggregate of a per-round signal list for the History
+        record: the mean when measured, NaN when instrumentation is on but
+        this epoch produced nothing (keeps the lists index-aligned with
+        train_loss), None (no append) when round stats are off."""
+        if values:
+            return float(np.mean(values))
+        return float("nan") if self.trainer.round_stats else None
+
+    def _finalize_round_stats(self) -> None:
+        """Fetch the epoch's accumulated round stats to the host (we're at
+        the epoch-end sync anyway — the one blocking read per epoch) and
+        derive the per-epoch signals: finite per-round divergence/spread
+        lists for the PS histograms, and the round-time skew ratio
+        max/median (the straggler signal; -1 with fewer than 2 rounds)."""
+        self._last_divergence = []
+        self._last_spread = []
+        for s in self._epoch_round_stats:
+            arr = np.asarray(s)
+            spread, div = float(arr[0]), float(arr[1])
+            # NaN marks a no-participant round — nothing to record
+            if np.isfinite(spread):
+                self._last_spread.append(spread)
+            if np.isfinite(div):
+                self._last_divergence.append(div)
+        self._epoch_round_stats = []
+        self._last_round_skew = -1.0
+        # skew is part of the round-stats instrumentation (the docs promise
+        # empty/-1 signals with KUBEML_ROUND_STATS=0), so it honors the
+        # same switch even though its input is the always-measured times
+        if self.trainer.round_stats and len(self._last_round_times) >= 2:
+            med = float(np.median(self._last_round_times))
+            if med > 0:
+                self._last_round_skew = float(
+                    max(self._last_round_times) / med)
+
     def _precompile_next_level(self, rb, epoch: int) -> None:
         """Kick a background AOT compile of sync_round at the next scale-up
         level (the ladder the scheduler walks, scheduler/policy.py). Round 1's
@@ -690,6 +753,9 @@ class TrainJob:
             "accuracy": list(h.accuracy),
             "parallelism": list(h.parallelism),
             "epoch_duration": list(h.epoch_duration),
+            "worker_divergence": list(h.worker_divergence),
+            "loss_spread": list(h.loss_spread),
+            "round_skew": list(h.round_skew),
             "notes": list(h.notes),
         }
 
@@ -808,7 +874,8 @@ class TrainJob:
         log.info("%s: resumed from checkpoint %s (epoch %d)", self.job_id, ck.tag, start_epoch)
         return start_epoch
 
-    def _push_metrics(self, train_loss, val_loss, acc_pct, elapsed, parallelism) -> None:
+    def _push_metrics(self, train_loss, val_loss, acc_pct, elapsed,
+                      parallelism, epochs_done: int = -1) -> None:
         if self.on_metrics is None:
             return
         try:
@@ -819,9 +886,13 @@ class TrainJob:
                     validation_loss=float(val_loss) if val_loss is not None else 0.0,
                     accuracy=float(acc_pct) if acc_pct is not None else 0.0,
                     parallelism=parallelism,
+                    epoch=int(epochs_done),
                     epoch_duration=float(elapsed),
                     round_seconds=[float(t) for t in self._last_round_times],
                     merge_seconds=float(self._last_merge_s),
+                    round_divergence=[float(v) for v in self._last_divergence],
+                    round_loss_spread=[float(v) for v in self._last_spread],
+                    round_skew_ratio=float(self._last_round_skew),
                 )
             )
         except Exception:

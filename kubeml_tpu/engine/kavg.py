@@ -181,6 +181,17 @@ class KAvgTrainer:
                 raise ValueError(f"mesh_shape['worker'] must be a positive int, got {cap!r}")
             self.devices = self.devices[:cap]
         self.donate = donate
+        # statistical-efficiency signals (KUBEML_ROUND_STATS): when on, the
+        # round program additionally returns [worker-loss spread, pre-merge
+        # weight divergence] as cheap on-chip reductions; when off the
+        # program is bit-identical to the uninstrumented round. The newest
+        # round's (lazy, undispatched-fetch) stats array is stashed on
+        # last_round_stats so callers pay the host read at epoch end, next
+        # to the loss fetch — never per round.
+        from ..api.config import get_config as _get_config
+
+        self.round_stats = _get_config().round_stats
+        self.last_round_stats = None
         self._train_cache: Dict[Tuple, Any] = {}
         self._eval_cache: Dict[Tuple, Any] = {}
         # None = not probed yet; see _schedule_is_traceable
@@ -419,11 +430,14 @@ class KAvgTrainer:
             return body(stacked_vars, x, y, mask, worker_mask, rng)
 
         sharded, replicated = self._shardings(n_workers)
+        outs = (sharded, replicated)
+        if self.round_stats:
+            outs += (replicated,)
         return jax.jit(
             sync_round,
             in_shardings=(sharded, sharded, sharded, sharded, replicated,
                           replicated, replicated, replicated),
-            out_shardings=(sharded, replicated),
+            out_shardings=outs,
             donate_argnums=(0,) if self.donate else (),
         )
 
@@ -437,10 +451,13 @@ class KAvgTrainer:
         tx = model.configure_optimizers()
         body = self._round_body(model, tx, n_workers, steps)
         sharded, replicated = self._shardings(n_workers)
+        outs = (sharded, replicated)
+        if self.round_stats:
+            outs += (replicated,)
         return jax.jit(
             body,
             in_shardings=(sharded, sharded, sharded, sharded, replicated, replicated),
-            out_shardings=(sharded, replicated),
+            out_shardings=outs,
             donate_argnums=(0,) if self.donate else (),
         )
 
@@ -487,6 +504,8 @@ class KAvgTrainer:
             active = (m_w.sum() > 0).astype(jnp.float32)
             return vars_f, worker_loss, active
 
+        stats = self.round_stats
+
         def round_body(stacked_vars, x, y, mask, worker_mask, rng):
             # device-side input pipeline: cast floats to the compute precision,
             # then the model's preprocess hook (e.g. uint8 -> scaled bf16)
@@ -499,12 +518,12 @@ class KAvgTrainer:
             vars_n, losses, active = jax.vmap(per_worker)(stacked_vars, x, y, mask, rngs)
             weights = worker_mask * active
             has_any = weights.sum() > 0
-            avg = _mean_over_workers(vars_n, weights)
+            mean0 = _mean_over_workers(vars_n, weights)
             # zero effective participants (e.g. chaos killed every data-bearing
             # worker while a fully-padded one stayed 'healthy') must keep the
             # pre-round weights, never average an empty set into zeros
             avg = jax.tree.map(
-                lambda a, b: jnp.where(has_any, a, b), avg, before
+                lambda a, b: jnp.where(has_any, a, b), mean0, before
             )
             # simple mean of participating workers' losses (train/util.go:82-95);
             # NaN marks a skipped round for the host to filter
@@ -513,7 +532,42 @@ class KAvgTrainer:
                 (losses * weights).sum() / jnp.maximum(weights.sum(), 1.0),
                 jnp.nan,
             )
-            return _broadcast_to_workers(avg, n_workers), mean_loss
+            out = _broadcast_to_workers(avg, n_workers)
+            if not stats:
+                return out, mean_loss
+            # statistical-efficiency signals, as on-chip reductions over
+            # tensors the round already materialized (XLA fuses them into
+            # the merge epilogue — no extra passes over HBM-resident data):
+            # * loss spread: max - min worker loss over effective
+            #   participants — which worker's shard is fighting the merge;
+            # * pre-merge weight divergence: the participant-weighted
+            #   Frobenius norm of (stacked vars - participant mean),
+            #   normalized by the mean's norm — the worker drift K local
+            #   steps accumulated before this averaging barrier, exactly
+            #   the quantity local SGD trades against K and parallelism
+            #   (Lin et al.; what a statistical-efficiency-aware policy
+            #   will read). Both NaN when the round had no participants.
+            big = jnp.float32(3.4e38)
+            lmax = jnp.max(jnp.where(weights > 0, losses, -big))
+            lmin = jnp.min(jnp.where(weights > 0, losses, big))
+            spread = jnp.where(has_any, lmax - lmin, jnp.nan)
+            denom_w = jnp.maximum(weights.sum(), 1.0)
+            num = jnp.float32(0.0)
+            den = jnp.float32(0.0)
+            for leaf_n, leaf_m in zip(jax.tree.leaves(vars_n),
+                                      jax.tree.leaves(mean0)):
+                if not jnp.issubdtype(leaf_n.dtype, jnp.floating):
+                    continue  # step counters etc. carry no drift signal
+                d = leaf_n.astype(jnp.float32) - leaf_m.astype(jnp.float32)[None]
+                w = weights.reshape((-1,) + (1,) * (d.ndim - 1))
+                num = num + (w * d * d).sum()
+                den = den + (leaf_m.astype(jnp.float32) ** 2).sum()
+            divergence = jnp.where(
+                has_any,
+                jnp.sqrt(num / denom_w) / jnp.maximum(jnp.sqrt(den), 1e-12),
+                jnp.nan,
+            )
+            return out, mean_loss, jnp.stack([spread, divergence])
 
         return round_body
 
@@ -567,9 +621,19 @@ class KAvgTrainer:
             jnp.asarray(worker_mask, jnp.float32),
             rng,
         )
+        def unpack(out):
+            """Split off the stats vector (when instrumented) and stash it
+            lazily; callers keep the historical (vars, loss) contract."""
+            if self.round_stats:
+                new_vars, loss, stats_vec = out
+                self.last_round_stats = stats_vec
+                return new_vars, loss
+            self.last_round_stats = None
+            return out
+
         if dynamic:
             try:
-                return fn(*args, jnp.float32(lr), jnp.int32(epoch))
+                return unpack(fn(*args, jnp.float32(lr), jnp.int32(epoch)))
             except jax.errors.ConcretizationTypeError:
                 # the probe only exercises optimizer CONSTRUCTION; a tx whose
                 # init/update closures branch on the captured lr/epoch passes
@@ -587,7 +651,7 @@ class KAvgTrainer:
                 return self.sync_round(stacked_vars, batch_x, batch_y, mask,
                                        rng, lr, epoch=epoch,
                                        worker_mask=worker_mask)
-        return fn(*args)
+        return unpack(fn(*args))
 
     def _train_key(self, n, steps, batch_shape, x_dtype, label_shape, y_dtype,
                    lr, epoch, dynamic: bool):

@@ -268,7 +268,7 @@ class SPMDJob:
                 )
                 if self._leader:
                     self._push_metrics(train_loss, val_loss, acc_pct, elapsed,
-                                       used_devices)
+                                       used_devices, epoch + 1)
                 log.info("%s: epoch %d/%d loss=%.4f val=%s acc=%s %.2fs",
                          self.job_id, epoch + 1, req.epochs, train_loss,
                          f"{val_loss:.4f}" if val_loss is not None else "-",
@@ -614,7 +614,8 @@ class SPMDJob:
                  self.job_id, tag, start)
         return start
 
-    def _push_metrics(self, train_loss, val_loss, acc_pct, elapsed, parallelism) -> None:
+    def _push_metrics(self, train_loss, val_loss, acc_pct, elapsed,
+                      parallelism, epochs_done: int = -1) -> None:
         if self.on_metrics is None:
             return
         try:
@@ -627,6 +628,7 @@ class SPMDJob:
                 validation_loss=float(val_loss) if val_loss is not None else 0.0,
                 accuracy=float(acc_pct) if acc_pct is not None else 0.0,
                 parallelism=parallelism,
+                epoch=int(epochs_done),
                 epoch_duration=float(elapsed),
                 moe_overflow=overflow,
             ))

@@ -45,11 +45,18 @@ GAUGES = {
     "kubeml_job_train_loss": "Train loss of a train job",
     "kubeml_job_parallelism": "Parallelism of a train job",
     "kubeml_job_epoch_duration_seconds": "Duration of the last epoch",
+    # epochs reported so far (one MetricUpdate per epoch) — the live
+    # training view's progress column; resets with a PS restart
+    "kubeml_job_epoch": "Epochs reported by a train job since it started",
     # extension: MoE expert-capacity overflow (dropped top-k assignment
     # fraction); series exists only for jobs whose model routes experts
     "kubeml_job_moe_overflow": "MoE expert-capacity overflow rate",
 }
 RUNNING = "kubeml_job_running_total"
+
+# elastic scale decisions, labeled by transition direction + enumerated
+# reason (scheduler/decisions.py; counts survive audit-ring eviction)
+SCALE_DECISIONS = "kubeml_scale_decisions_total"
 
 # default bucket edges (seconds): spans sub-10ms decode steps through
 # multi-minute epochs; +Inf is implicit
@@ -206,8 +213,31 @@ HISTOGRAMS = {
     "kubeml_job_merge_seconds": (
         "Epoch-end merge/loss sync wall time (the on-chip K-AVG merge is "
         "awaited here)"),
+    # statistical-efficiency signals (engine/kavg.py round program,
+    # KUBEML_ROUND_STATS): what elastic scaling COSTS statistically —
+    # per-round distributions, fed from MetricUpdate each epoch
+    "kubeml_job_worker_divergence": (
+        "Pre-merge worker weight divergence per K-AVG round (norm of the "
+        "stacked worker vars minus their mean, over the mean's norm)"),
+    "kubeml_job_loss_spread": (
+        "Worker-loss spread per K-AVG round (max - min over effective "
+        "participants)"),
+    "kubeml_job_round_skew_ratio": (
+        "Per-epoch round-time skew (max/median over the epoch's rounds — "
+        "the straggler signal)"),
 }
 MAX_HISTOGRAM_JOBS = 32
+
+# ratio-valued histograms need ratio-scaled edges, not latency seconds:
+# divergence/spread live in ~1e-5..1, skew is >= 1 with a heavy tail
+RATIO_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 0.001, 0.0025,
+                 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+SKEW_BUCKETS = (1.0, 1.1, 1.25, 1.5, 2.0, 3.0, 5.0, 10.0, 25.0, 100.0)
+HISTOGRAM_BUCKETS = {
+    "kubeml_job_worker_divergence": RATIO_BUCKETS,
+    "kubeml_job_loss_spread": RATIO_BUCKETS,
+    "kubeml_job_round_skew_ratio": SKEW_BUCKETS,
+}
 
 # serving histograms: rendered from the decoders' telemetry snapshots
 # (serving/stats.py feeds Histogram.snapshot() dicts under snap["hist"])
@@ -327,6 +357,9 @@ class MetricsRegistry:
         self._preemptions: Dict[str, int] = {}
         self._yield_hist = Histogram()
         self._queue_source = None
+        # () -> {(direction, reason): count} from the scheduler's decision
+        # log (kubeml_scale_decisions_total); read at render/sample time
+        self._decision_source = None
         # per-job high-water mark of applied dataplane delta batches
         # (MetricUpdate.dataplane seqs): a redelivered batch — the runner
         # re-sends until a client-observed ack — must fold into the
@@ -349,6 +382,31 @@ class MetricsRegistry:
         """() -> {priority: queued count} (scheduler.queue.depths); read at
         render time so the exposition never holds the queue lock long."""
         self._queue_source = source
+
+    def set_decision_source(self, source) -> None:
+        """() -> {(direction, reason): count} (scheduler DecisionLog.counts)
+        — the kubeml_scale_decisions_total export; read at render/sample
+        time, same no-nested-lock discipline as the queue source."""
+        self._decision_source = source
+
+    def decisions_snapshot(self) -> Dict[tuple, int]:
+        """{(direction, reason): cumulative count} from the bound decision
+        source ({} when unbound/broken)."""
+        source = getattr(self, "_decision_source", None)
+        if source is None:
+            return {}
+        try:
+            return dict(source() or {})
+        except Exception:
+            return {}
+
+    def job_gauges_snapshot(self) -> Dict[Tuple[str, str], float]:
+        """{(metric, jobid): latest value} — every per-job scalar the
+        registry holds (the GAUGES values plus the statistical-efficiency
+        epoch means), for the tsdb sampler so training series land in
+        GET /metrics/history next to the serving ones."""
+        with self._lock:
+            return dict(self._values)
 
     def preemption(self, reason: str) -> None:
         """Count one preemption decision (kubeml_preemptions_total{reason})."""
@@ -403,6 +461,14 @@ class MetricsRegistry:
             self._values[("kubeml_job_train_loss", jid)] = u.train_loss
             self._values[("kubeml_job_parallelism", jid)] = float(u.parallelism)
             self._values[("kubeml_job_epoch_duration_seconds", jid)] = u.epoch_duration
+            # epoch progress: the job reports its own (resume-correct)
+            # epoch count; engines predating the field fall back to
+            # counting pushes (one MetricUpdate arrives per epoch)
+            if u.epoch >= 0:
+                self._values[("kubeml_job_epoch", jid)] = float(u.epoch)
+            else:
+                self._values[("kubeml_job_epoch", jid)] = (
+                    self._values.get(("kubeml_job_epoch", jid), 0.0) + 1.0)
             if u.moe_overflow >= 0.0:
                 self._values[("kubeml_job_moe_overflow", jid)] = u.moe_overflow
             # promote the flattened timings into real distributions
@@ -412,6 +478,26 @@ class MetricsRegistry:
             if u.merge_seconds >= 0.0:
                 self._observe("kubeml_job_merge_seconds", jid,
                               (u.merge_seconds,))
+            # statistical-efficiency signals: per-round observations into
+            # the histograms, plus the epoch mean stashed under the SAME
+            # name for the tsdb sampler (job_gauges_snapshot) — the series
+            # `kubeml top` and /metrics/history read. Not in GAUGES, so the
+            # exposition renders them as histograms only.
+            if u.round_divergence:
+                self._observe("kubeml_job_worker_divergence", jid,
+                              u.round_divergence)
+                self._values[("kubeml_job_worker_divergence", jid)] = (
+                    sum(u.round_divergence) / len(u.round_divergence))
+            if u.round_loss_spread:
+                self._observe("kubeml_job_loss_spread", jid,
+                              u.round_loss_spread)
+                self._values[("kubeml_job_loss_spread", jid)] = (
+                    sum(u.round_loss_spread) / len(u.round_loss_spread))
+            if u.round_skew_ratio >= 0.0:
+                self._observe("kubeml_job_round_skew_ratio", jid,
+                              (u.round_skew_ratio,))
+                self._values[("kubeml_job_round_skew_ratio", jid)] = (
+                    u.round_skew_ratio)
 
     def _observe(self, metric: str, job_id: str, values) -> None:
         """Observe into a per-(metric, jobid) histogram; caller holds _lock.
@@ -422,7 +508,8 @@ class MetricsRegistry:
             return
         h = self._hists.get((metric, job_id))
         if h is None:
-            h = self._hists[(metric, job_id)] = Histogram()
+            h = self._hists[(metric, job_id)] = Histogram(
+                HISTOGRAM_BUCKETS.get(metric, LATENCY_BUCKETS))
             jobs = [j for m, j in self._hists if m == metric]
             while len(jobs) > MAX_HISTOGRAM_JOBS:
                 self._hists.pop((metric, jobs.pop(0)), None)
@@ -526,6 +613,17 @@ class MetricsRegistry:
             for prio, n in sorted(depths.items()):
                 lines.append(f'{QUEUE_DEPTH}{{priority='
                              f'"{escape_label_value(prio)}"}} {n}')
+        # elastic scale-decision counters (scheduler/decisions.py) — the
+        # audit trail's aggregate view, labeled by transition direction and
+        # enumerated reason. Headers render even before any decision so the
+        # exported metric set is stable.
+        lines.append(f"# HELP {SCALE_DECISIONS} Elastic scale decisions by "
+                     f"transition direction and enumerated reason")
+        lines.append(f"# TYPE {SCALE_DECISIONS} counter")
+        for (direction, reason), n in sorted(self.decisions_snapshot().items()):
+            lines.append(
+                f'{SCALE_DECISIONS}{{direction="{escape_label_value(direction)}"'
+                f',reason="{escape_label_value(reason)}"}} {int(n)}')
         # serving telemetry OUTSIDE the lock: the source snapshots each
         # decoder under its own lock and must not nest under ours. HELP/TYPE
         # headers render even with no source/decoders — the exported metric

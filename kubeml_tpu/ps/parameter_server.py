@@ -946,10 +946,14 @@ class ParameterServer:
     def _collect_series(self) -> Dict[str, float]:
         """One registry sample: every serving counter/gauge per model (the
         exposition's own name/label scheme so /metrics/history correlates
-        1:1 with /metrics), scheduler queue depths, running-task gauges and
-        the preemption counter."""
+        1:1 with /metrics), scheduler queue depths, running-task gauges,
+        the preemption counter, per-job TRAINING gauges (parallelism, loss,
+        epoch progress, the statistical-efficiency signals — the elastic
+        timeline `kubeml top` and the decision audit correlate against),
+        and the scale-decision counters."""
         from .metrics import (PREEMPTIONS, QUEUE_DEPTH, RUNNING,
-                              SERVING_COUNTERS, SERVING_GAUGES)
+                              SCALE_DECISIONS, SERVING_COUNTERS,
+                              SERVING_GAUGES)
 
         out: Dict[str, float] = {}
         for model, snap in self._serving_telemetry().items():
@@ -964,6 +968,13 @@ class ParameterServer:
             sum(self.metrics.preemptions_snapshot().values()))
         for prio, n in self.metrics.queue_depths().items():
             out[f'{QUEUE_DEPTH}{{priority="{prio}"}}'] = float(n)
+        # per-job training series (cleared from the registry when the job
+        # finishes, so rings stop growing but retain the job's timeline)
+        for (metric, jid), v in self.metrics.job_gauges_snapshot().items():
+            out[f'{metric}{{jobid="{jid}"}}'] = float(v)
+        for (direction, reason), n in self.metrics.decisions_snapshot().items():
+            out[f'{SCALE_DECISIONS}{{direction="{direction}"'
+                f',reason="{reason}"}}'] = float(n)
         return out
 
     def metrics_history(self, match: Optional[str] = None,

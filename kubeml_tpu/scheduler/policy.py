@@ -17,6 +17,7 @@ import threading
 from typing import Dict, Optional, Protocol, Tuple
 
 from ..api.types import JobState
+from .decisions import DecisionLog, REASONS, ScaleDecision
 
 # Reference thresholds (ml/pkg/scheduler/policy.go:9-12): an epoch that stayed
 # within 1.05x of the cached time scales up; one 1.2x or slower scales down.
@@ -72,6 +73,31 @@ class ThroughputBasedPolicy:
         # insertion-ordered bounded set of finished job ids (stale-update guard)
         self._finished: Dict[str, None] = {}
         self._lock = threading.Lock()
+        # scale-decision audit trail (scheduler.decisions): bound by the
+        # scheduler; None = decisions are not recorded (bare policy in tests)
+        self.decision_log: Optional[DecisionLog] = None
+
+    def bind_decision_log(self, log: DecisionLog) -> None:
+        self.decision_log = log
+
+    def _record(self, job_id: str, from_p: int, to_p: int, reason: str,
+                cached: Optional[float], elapsed: Optional[float]) -> None:
+        """Audit one outcome (no-op without a bound log). Inputs that the
+        JSON wire cannot carry (the infinity cache seed, the <0 first-call
+        elapsed sentinel) are recorded as None."""
+        if self.decision_log is None:
+            return
+        if cached is not None and cached == float("inf"):
+            cached = None
+        self.decision_log.record(ScaleDecision(
+            job_id=job_id, from_p=from_p, to_p=to_p,
+            direction=REASONS[reason][0], reason=reason,
+            cached=cached, elapsed=elapsed,
+            speedup_threshold=SPEEDUP_THRESHOLD,
+            slowdown_threshold=SLOWDOWN_THRESHOLD,
+            cap=self.max_parallelism,
+            limit_parallelism=self.limit_parallelism,
+        ))
 
     def calculate_parallelism(self, task) -> Optional[Tuple[int, bool]]:
         """Returns (parallelism, is_new), or ``None`` when the update is stale
@@ -89,8 +115,12 @@ class ThroughputBasedPolicy:
                 p = task.parameters.options.default_parallelism or self.default_parallelism
                 p = max(1, min(p, self.max_parallelism))
                 self._time_cache[job_id] = float("inf")
+                self._record(job_id, 0, p, "new-task", None, None)
                 return p, True
             if job_id in self._finished:
+                p = max(0, state.parallelism)
+                self._record(job_id, p, p, "stale-drop", None,
+                             state.elapsed_time)
                 return None
             cached = self._time_cache.get(job_id)
             if cached is None:
@@ -98,16 +128,24 @@ class ThroughputBasedPolicy:
                 # parallelism but reseed the cache so elasticity resumes next
                 # epoch.
                 self._time_cache[job_id] = state.elapsed_time
-                return max(1, state.parallelism), False
+                p = max(1, state.parallelism)
+                self._record(job_id, p, p, "reseed", None, state.elapsed_time)
+                return p, False
             p = max(1, state.parallelism)
             elapsed = state.elapsed_time
             if elapsed <= cached * SPEEDUP_THRESHOLD and not self.limit_parallelism:
                 new_p = next_power_up(p, self.max_parallelism)
+                reason = "speedup" if new_p > p else "at-cap"
             elif elapsed >= cached * SLOWDOWN_THRESHOLD:
                 new_p = next_power_down(p)
+                reason = "slowdown" if new_p < p else "at-floor"
             else:
                 new_p = p
+                reason = ("limited" if (self.limit_parallelism
+                                        and elapsed <= cached * SPEEDUP_THRESHOLD)
+                          else "steady")
             self._time_cache[job_id] = elapsed
+            self._record(job_id, p, new_p, reason, cached, elapsed)
             return new_p, False
 
     def task_finished(self, job_id: str) -> None:

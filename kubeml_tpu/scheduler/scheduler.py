@@ -21,6 +21,7 @@ from ..api.config import Config, get_config
 from ..api.errors import KubeMLError
 from ..api.types import JobState, TrainRequest, TrainTask
 from ..utils import tracing
+from .decisions import DecisionLog
 from .policy import SchedulerPolicy, ThroughputBasedPolicy
 from .queue import TaskQueue, TenantUsage, task_tenant
 
@@ -72,9 +73,17 @@ class Scheduler:
         # a preempted job immediately (it re-enters behind whatever
         # outranked it)
         self.preemption = None
-        # per-priority queue gauges on the PS exposition
+        # scale-decision audit trail: every policy outcome records its full
+        # inputs + an enumerated reason, served at GET /jobs/{id}/decisions
+        # and exported as kubeml_scale_decisions_total{direction,reason}
+        self.decisions = DecisionLog(per_job=self.cfg.decision_log_size,
+                                     max_jobs=self.cfg.decision_log_jobs)
+        if hasattr(self.policy, "bind_decision_log"):
+            self.policy.bind_decision_log(self.decisions)
+        # per-priority queue gauges + decision counters on the PS exposition
         try:
             ps.metrics.set_queue_source(self.queue.depths)
+            ps.metrics.set_decision_source(self.decisions.counts)
         except AttributeError:
             pass  # bare test doubles without a metrics registry
 
@@ -157,6 +166,16 @@ class Scheduler:
         the scheduler's half of the `kubeml jobs` operator view (the PS
         contributes running/preempted)."""
         return self.queue.snapshot()
+
+    def job_decisions(self, job_id: str) -> dict:
+        """`GET /jobs/{id}/decisions`: the retained scale-decision audit
+        trail of one job, oldest first, each entry carrying the transition
+        (from->to, direction), the enumerated reason, and the policy inputs
+        that produced it. ``total`` counts decisions ever recorded (>=
+        len(decisions) once the bounded ring wraps)."""
+        return {"job_id": job_id,
+                "decisions": self.decisions.for_job(job_id),
+                "total": self.decisions.total(job_id)}
 
     def infer(self, model_id: str, data):
         """`/infer`: bypasses the queue straight to the serving path (api.go:119-162)."""

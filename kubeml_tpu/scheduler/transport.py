@@ -31,6 +31,9 @@ class SchedulerAPI:
         router.route("POST", "/job", self._job)
         router.route("POST", "/preempted", self._preempted)
         router.route("GET", "/jobs", self._jobs)
+        # scale-decision audit trail (scheduler/decisions.py): why each
+        # elastic transition happened, with its full policy inputs
+        router.route("GET", "/jobs/{jobId}/decisions", self._job_decisions)
         router.route("DELETE", "/finish/{taskId}", self._finish)
         self.service = Service(router, self.cfg.host, self.cfg.scheduler_port)
 
@@ -63,6 +66,9 @@ class SchedulerAPI:
 
     def _jobs(self, req: Request):
         return self.scheduler.jobs_snapshot()
+
+    def _job_decisions(self, req: Request):
+        return self.scheduler.job_decisions(req.params["jobId"])
 
     def _finish(self, req: Request):
         self.scheduler.finish_job(req.params["taskId"])
@@ -158,6 +164,10 @@ class SchedulerClient:
 
     def jobs_snapshot(self) -> list:
         return _check(requests.get(f"{self.url}/jobs",
+                                   timeout=self._timeout()))
+
+    def job_decisions(self, job_id: str) -> dict:
+        return _check(requests.get(f"{self.url}/jobs/{job_id}/decisions",
                                    timeout=self._timeout()))
 
     def finish_job(self, job_id: str) -> None:

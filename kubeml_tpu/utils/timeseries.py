@@ -80,6 +80,13 @@ class Series:
         with self._lock:
             return self._samples[-1][1] if self._samples else None
 
+    def last_time(self) -> Optional[float]:
+        """Timestamp of the newest sample (None when empty) — consumers
+        use it to tell a live series from one whose feeder stopped (e.g. a
+        finished job's gauges, which the ring retains)."""
+        with self._lock:
+            return self._samples[-1][0] if self._samples else None
+
     # --- counter queries ---
 
     def increase(self, window: float, now: Optional[float] = None,
@@ -257,6 +264,11 @@ class TimeSeriesStore:
             latest = s.latest()
             if latest is not None:
                 entry["latest"] = latest
+                # newest-sample age lets consumers drop stale series even
+                # with samples=0 (kubeml top's liveness filter)
+                last_t = s.last_time()
+                if last_t is not None:
+                    entry["last_t"] = round(last_t, 3)
             if include_samples:
                 entry["samples"] = [[round(t, 3), v] for t, v in
                                     s.samples(window, now=now)]

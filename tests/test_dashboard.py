@@ -29,16 +29,20 @@ def _exported_names() -> set:
     from kubeml_tpu.utils import profiler, resilience
 
     reg = MetricsRegistry()
-    # job gauges + histograms
+    # job gauges + histograms (incl. the statistical-efficiency signals)
     reg.update(MetricUpdate(job_id="drift-job", validation_loss=1.0,
                             accuracy=0.5, train_loss=1.0, parallelism=2,
                             epoch_duration=1.0, moe_overflow=0.1,
-                            round_seconds=[0.1], merge_seconds=0.2))
+                            round_seconds=[0.1], merge_seconds=0.2,
+                            round_divergence=[0.01],
+                            round_loss_spread=[0.1],
+                            round_skew_ratio=1.5))
     reg.task_started()
-    # preemption series + per-priority queue gauges
+    # preemption series + per-priority queue gauges + scale decisions
     reg.preemption("drift")
     reg.observe_yield(0.5)
     reg.set_queue_source(lambda: {0: 1})
+    reg.set_decision_source(lambda: {("up", "speedup"): 1})
     # serving telemetry: one decoder with every counter/gauge/histogram fed
     stats = DecoderStats(slots=4)
     stats.submitted(1)
@@ -131,3 +135,27 @@ def test_new_observability_panels_present():
                    "kubeml_slo_alert_state",
                    "kubeml_serving_queue_wait_seconds_bucket"):
         assert metric in refs, f"no panel charts {metric}"
+
+
+def test_elastic_observability_panels_present():
+    """The PR-13 panels: the parallelism timeline, scale decisions by
+    direction/reason, and the statistical-efficiency histograms (worker
+    divergence, loss spread, round skew) — elastic training must be
+    chartable next to the serving view."""
+    refs = _dashboard_names()
+    for metric in ("kubeml_job_parallelism",
+                   "kubeml_scale_decisions_total",
+                   "kubeml_job_worker_divergence_bucket",
+                   "kubeml_job_loss_spread_bucket",
+                   "kubeml_job_round_skew_ratio_bucket"):
+        assert metric in refs, f"no panel charts {metric}"
+
+
+def test_unique_panel_ids():
+    """Grafana resolves panels by id — duplicates make edits land on the
+    wrong panel (earlier PRs appended id-less panels; ids are now
+    assigned)."""
+    doc = json.loads(DASHBOARD.read_text())
+    ids = [p.get("id") for p in doc["panels"]]
+    assert None not in ids, "panel without an id"
+    assert len(ids) == len(set(ids)), "duplicate panel ids"

@@ -163,7 +163,11 @@ def test_dashboard_metrics_exist_in_registry():
     reg.task_started("train")
     reg.update(MetricUpdate(job_id="j", train_loss=1.0, validation_loss=2.0,
                             accuracy=50.0, parallelism=2, epoch_duration=1.5,
-                            round_seconds=[0.2], merge_seconds=0.05))
+                            round_seconds=[0.2], merge_seconds=0.05,
+                            round_divergence=[0.01], round_loss_spread=[0.1],
+                            round_skew_ratio=1.5))
+    # scale-decision counters (the decisions-by-reason panel queries them)
+    reg.set_decision_source(lambda: {("up", "speedup"): 1})
     # serving traffic so the histogram _bucket series render too (the
     # dashboard's histogram_quantile panels query those directly)
     stats = DecoderStats(slots=2)
