@@ -326,6 +326,34 @@ class Config:
     # prefill runs only on the unshared suffix
     serving_prefix_cache: bool = field(
         default_factory=lambda: _env_bool("KUBEML_SERVING_PREFIX_CACHE", True))
+    # --- speculative decoding (paged engine only; serving/batcher.py
+    # spec mode + models/generation.py acceptance math) ---
+    # drafter backend: "off" (default), "self" (early-exit logits from a
+    # truncated layer stack of the target — no second model), or "draft"
+    # (a separate small model named by KUBEML_SPEC_DRAFT_MODEL). Greedy
+    # spec decode is bit-identical to the baseline; sampled decode
+    # preserves the target distribution exactly (accept min(1, p/q),
+    # resample the residual).
+    serving_spec: str = field(
+        default_factory=lambda: os.environ.get("KUBEML_SERVING_SPEC", "off"))
+    # tokens the drafter proposes per verify step (the adaptive controller
+    # walks k down/up a pow2 ladder bounded by this; also the worst-case
+    # page-reservation lookahead, so it is a capacity knob too)
+    spec_k: int = field(default_factory=lambda: _env_int("KUBEML_SPEC_K", 4))
+    # adapt k to the measured acceptance rate (shrink on low acceptance,
+    # grow on high; self-drafting retreats to plain decode entirely and
+    # re-probes). 0 pins k at KUBEML_SPEC_K.
+    spec_adaptive: bool = field(
+        default_factory=lambda: _env_bool("KUBEML_SPEC_ADAPTIVE", True))
+    # the draft model for spec=draft: a finished job id whose final
+    # checkpoint (preferring the final-int8 tag under int8 serving — the
+    # drafter rides the quantized-checkpoint store) loads as the drafter
+    spec_draft_model: str = field(
+        default_factory=lambda: os.environ.get("KUBEML_SPEC_DRAFT_MODEL", ""))
+    # early-exit depth for spec=self (blocks run before ln_f + lm_head);
+    # 0 derives depth // 2
+    spec_exit_layer: int = field(
+        default_factory=lambda: _env_int("KUBEML_SPEC_EXIT_LAYER", 0))
 
     def serving_mesh_axes(self) -> dict:
         """Parsed ``serving_mesh`` ({} when disabled); same ``ax=n`` comma

@@ -24,12 +24,30 @@ from typing import Dict, Optional, Tuple
 # fraction_of_batchN — the long-workload continuous-batching ratio that used
 # to live only as a note in results/SERVING_R5_NOTE.md); train rows don't
 # carry the field, so the gate skips it there instead of failing.
+#
+# DIRECTION is per metric, not assumed: "higher" means a drop beyond the
+# threshold regresses (throughputs, ratios), "lower" means a RISE does
+# (latencies). The compare code reads this table, so the spec-decode gate
+# (tokens/step, acceptance — benchmarks/spec_decode.py rows) and the
+# serving-fraction gate share one code path.
 GATE_METRICS = {
     "device_samples_per_sec": ("value", "higher"),
     "end_to_end_samples_per_sec": ("end_to_end", "higher"),
     "mfu": ("mfu", "higher"),
     "serving_fraction_of_one_shot": ("fraction_of_batchN", "higher"),
+    # speculative decoding (results/spec_decode.jsonl rows): emitted tokens
+    # per verify step and the drafter's acceptance rate — a drafter
+    # regression (worse acceptance, fewer tokens/step) fails the gate
+    "spec_tokens_per_step": ("spec_tokens_per_step", "higher"),
+    "spec_accept_ratio": ("spec_accept_ratio", "higher"),
+    # serving latency rides the same table with the opposite direction
+    "serving_latency_p95_ms": ("latency_p95_ms", "lower"),
 }
+
+
+def metric_direction(key: str) -> str:
+    """The gate direction for a normalized metric key ("higher"/"lower")."""
+    return GATE_METRICS[key][1]
 
 
 def normalize_bench_row(doc: dict) -> Dict[str, Optional[float]]:

@@ -59,13 +59,17 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
              new_tokens: int = NEW_TOKENS, stagger: float = 0.0,
              quantize: str = "", int8_matmul: bool = False,
              paged: bool = False, mixed_prompts: bool = False,
-             long_workload: bool = False) -> dict:
+             long_workload: bool = False, spec: str = "off",
+             spec_k: int = 4) -> dict:
     """N HTTP clients against a live cluster serving a final checkpoint.
 
     ``paged`` routes serving through the paged KV-cache engine
     (PagedBatchingDecoder); ``mixed_prompts`` gives each client its own
     prompt length (8..PROMPT_LEN cycling) — the chat-shaped mixed-length
-    traffic the paged allocator exists for."""
+    traffic the paged allocator exists for. ``spec`` ("draft"|"self")
+    turns on speculative decoding (implies ``paged``); the row then
+    carries ``spec_tokens_per_step`` and ``spec_accept_ratio`` scraped
+    from the PS /metrics exposition — the gated drafter-quality truth."""
     import os
     import socket
     import tempfile
@@ -83,10 +87,14 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
             s.bind(("127.0.0.1", 0))
             return s.getsockname()[1]
 
+    spec = (spec or "off").lower()
+    if spec != "off":
+        paged = True  # speculation lives on the paged engine
     cfg = Config(controller_port=fp(), scheduler_port=fp(), ps_port=fp(),
                  storage_port=fp(), serving_slots=slots,
                  serving_chunk_steps=chunk_steps, serving_quantize=quantize,
-                 int8_matmul=int8_matmul, serving_paged=paged)
+                 int8_matmul=int8_matmul, serving_paged=paged,
+                 serving_spec=spec, spec_k=spec_k)
     cfg.ensure_dirs()
     set_config(cfg)
 
@@ -178,6 +186,30 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
         t0 = time.perf_counter()
         requests.post(f"{url}/generate", json=body, timeout=300)
         solo.append(time.perf_counter() - t0)
+    # speculative-decoding truth off the REAL PS /metrics scrape (the same
+    # exposition Prometheus reads): tokens per verify step + acceptance
+    spec_metrics = {}
+    if spec != "off":
+        try:
+            text = requests.get(f"{cfg.ps_url}/metrics", timeout=30).text
+
+            def mval(name):
+                for line in text.splitlines():
+                    if line.startswith(name + "{"):
+                        return float(line.rsplit(" ", 1)[1])
+                return None
+
+            toks = mval("kubeml_serving_tokens_total")
+            steps = mval("kubeml_serving_device_steps_total")
+            drafted = mval("kubeml_serving_spec_drafted_tokens_total")
+            accepted = mval("kubeml_serving_spec_accepted_tokens_total")
+            if toks and steps:
+                spec_metrics["spec_tokens_per_step"] = round(toks / steps, 3)
+            if drafted:
+                spec_metrics["spec_accept_ratio"] = round(
+                    (accepted or 0.0) / drafted, 3)
+        except Exception as e:  # the load row survives a scrape hiccup
+            spec_metrics["spec_scrape_error"] = str(e)
     cluster.stop()
 
     total = sum(counts)
@@ -202,6 +234,8 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
         "latency_p95_ms": round(1000 * float(np.percentile(latencies, 95)), 1) if latencies else None,
         "solo_latency_ms": round(1000 * min(solo), 1),
         "errors": errors[:3],
+        **({"spec": spec, "spec_k": spec_k} if spec != "off" else {}),
+        **spec_metrics,
     }
 
 
@@ -224,6 +258,12 @@ def main(argv=None) -> int:
                    help="serve through the paged KV-cache engine "
                         "(PagedBatchingDecoder: block allocator, page-budget "
                         "admission, shared-prefix reuse)")
+    p.add_argument("--spec", default="off", choices=("off", "draft", "self"),
+                   help="speculative decoding mode (implies --paged): "
+                        "'self' = early-exit self-drafting, 'draft' = the "
+                        "KUBEML_SPEC_DRAFT_MODEL checkpoint drafts")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="drafted tokens per verify step (adaptive ladder cap)")
     p.add_argument("--mixed-prompts", action="store_true",
                    help="give each client its own prompt length (mixed-depth "
                         "rows in one decode program)")
@@ -247,7 +287,8 @@ def main(argv=None) -> int:
                    new_tokens=args.new_tokens, stagger=args.stagger,
                    quantize=args.quantize, int8_matmul=args.int8_matmul,
                    paged=args.paged, mixed_prompts=args.mixed_prompts,
-                   long_workload=args.long_workload)
+                   long_workload=args.long_workload, spec=args.spec,
+                   spec_k=args.spec_k)
     if args.quantize:
         row["quantize"] = args.quantize
         row["int8_matmul"] = bool(args.int8_matmul)

@@ -1,0 +1,357 @@
+"""Speculative-decoding proof rows — CPU-measurable, no chip needed
+(scripts/spec_decode_demo.sh -> results/spec_decode.jsonl).
+
+One mixed-length workload is driven through the paged serving engine with
+speculation off / the separate-draft-model backend / early-exit
+self-drafting, at batch 1 and batch 8, greedy and sampled. Each row
+records the engine's token-truth accounting: ``spec_tokens_per_step``
+(emitted tokens per device step — the whole point: >1 means each weight
+stream over HBM amortized across multiple emitted tokens) and
+``spec_accept_ratio`` (drafter quality), both of which ride the
+bench_compare gate with higher-is-better direction metadata.
+
+Drafter quality on RANDOM weights is meaningless (a random model's late
+layers dominate its logits), so the self-drafting rows run against a
+``coherent-tail`` target: the blocks past the exit layer have their
+residual-branch output projections scaled toward zero, making the
+truncated stack agree with the full one — the regime trained models
+approach as layers saturate, produced synthetically so the demo is
+deterministic. The draft-backend rows keep the honest random drafter
+(low acceptance — the adaptive controller's retreat case is itself part
+of the proof).
+
+Gate (exit status mirrors it — ISSUE 14 acceptance):
+
+a. greedy TOKEN PARITY in every mode (incl. the int8 compose row)
+   against the one-shot ``models.generation.generate`` baseline;
+b. self-drafting at batch 1 emits ``spec_tokens_per_step > 1.0``;
+c. the acceptance-rate counters are live on a REAL PS ``/metrics``
+   HTTP scrape (PSAPI serving a finished checkpoint with
+   KUBEML_SERVING_SPEC=self).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import List, Optional
+
+import numpy as np
+
+VOCAB = 101
+MAX_LEN = 96
+DEPTH = 4
+EXIT_LAYER = 2
+
+
+def _model():
+    from ..models.gpt import CausalTransformer
+
+    return CausalTransformer(vocab_size=VOCAB, max_len=MAX_LEN, embed_dim=64,
+                             depth=DEPTH, num_heads=4)
+
+
+def _draft_model():
+    from ..models.gpt import CausalTransformer
+
+    return CausalTransformer(vocab_size=VOCAB, max_len=MAX_LEN, embed_dim=32,
+                             depth=2, num_heads=4)
+
+
+def coherent_tail(variables, exit_layer: int, eps: float = 0.02):
+    """Scale the residual-branch OUTPUT projections of every block past
+    ``exit_layer`` by ``eps``: those blocks become near-identity, so the
+    truncated early-exit stack agrees with the full forward — the
+    late-layer-saturation regime self-drafting exploits in trained
+    models, constructed synthetically for a deterministic demo."""
+    import jax
+
+    def one(path, leaf):
+        keys = [str(getattr(k, "key", k)) for k in path]
+        blk = next((k for k in keys if k.startswith("block_")), None)
+        if blk is None or int(blk.split("_")[1]) < exit_layer:
+            return leaf
+        if any(k in ("proj", "mlp_out") for k in keys):
+            return leaf * eps
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(one, variables)
+
+
+def _workload(seed: int, n: int, max_new: int, sampled: bool) -> List[dict]:
+    rng = np.random.default_rng(seed)
+    specs = []
+    for i in range(n):
+        plen = int(rng.integers(4, 24))
+        specs.append({
+            "prompt": rng.integers(1, VOCAB, size=plen).astype(np.int32),
+            "max_new": max_new,
+            "temp": 0.8 if sampled else 0.0,
+            "seed": 500 + i,
+        })
+    return specs
+
+
+def _drive(decoder, specs: List[dict]) -> dict:
+    from ..api.types import GenerateRequest
+
+    t0 = time.perf_counter()
+    entries = [decoder.submit(GenerateRequest(
+        prompts=[s["prompt"].tolist()], max_new_tokens=s["max_new"],
+        temperature=s["temp"],
+        seed=s["seed"] if s["temp"] > 0 else None)) for s in specs]
+    outs = [decoder.wait(e, timeout=600) for e in entries]
+    wall = time.perf_counter() - t0
+    t = decoder.telemetry()
+    chk = decoder._pool.check()  # raises on any allocator invariant break
+    assert chk["held"] == chk["trie_pages"], "pages leaked past the trie"
+    assert (t["goodput_tokens"] + t["wasted_tokens"]
+            == t["tokens_emitted"]), "goodput+wasted != emitted"
+    return {"outs": outs, "wall": wall, "telemetry": t}
+
+
+def run_rows(seed: int, requests_n: int, max_new: int, slots: int,
+             chunk_steps: int, page_tokens: int, spec_k: int) -> List[dict]:
+    import jax
+
+    from ..models.generation import generate
+    from ..serving.batcher import PagedBatchingDecoder
+
+    m = _model()
+    variables = m.init(jax.random.PRNGKey(seed),
+                       np.zeros((1, 8), np.int32))
+    coherent = coherent_tail(variables, EXIT_LAYER)
+    dm = _draft_model()
+    dvars = dm.init(jax.random.PRNGKey(seed + 1),
+                    np.zeros((1, 8), np.int32))
+
+    def refs(vs, specs):
+        return [np.asarray(generate(
+            m, vs, s["prompt"][None], max_new_tokens=s["max_new"]
+        ).tokens)[0].tolist() for s in specs]
+
+    rows = []
+    ok = True
+    modes = [
+        ("off", variables, {}),
+        ("draft", variables, dict(spec="draft", draft_module=dm,
+                                  draft_variables=dvars)),
+        ("self", coherent, dict(spec="self", spec_exit_layer=EXIT_LAYER)),
+    ]
+    for batch in (1, 8):
+        for sampled in (False, True):
+            specs = _workload(seed, requests_n, max_new, sampled)
+            for mode, vs, kw in modes:
+                dec = PagedBatchingDecoder(
+                    m, vs, slots=min(slots, max(batch, 2)),
+                    chunk_steps=chunk_steps, page_tokens=page_tokens,
+                    spec_k=spec_k, spec_adaptive=(mode == "draft"), **kw)
+                try:
+                    # batch shapes the offered concurrency: batch 1 submits
+                    # serially (the low-occupancy regime speculation
+                    # exists for), batch 8 floods all requests at once
+                    if batch == 1:
+                        res = {"outs": [], "wall": 0.0}
+                        t0 = time.perf_counter()
+                        for s in specs:
+                            res["outs"].extend(_drive(dec, [s])["outs"])
+                        res["wall"] = time.perf_counter() - t0
+                        res["telemetry"] = dec.telemetry()
+                    else:
+                        res = _drive(dec, specs)
+                    t = res["telemetry"]
+                    parity = None
+                    if not sampled:
+                        want = refs(vs, specs)
+                        got = [o["tokens"][0] for o in res["outs"]]
+                        parity = got == want
+                        ok = ok and parity
+                    tps = (t["tokens_emitted"] / t["device_steps"]
+                           if t.get("device_steps") else None)
+                    row = {
+                        "metric": "spec-decode-serving",
+                        "mode": mode, "batch": batch,
+                        "sampling": "sampled" if sampled else "greedy",
+                        "spec_k": spec_k if mode != "off" else 0,
+                        "requests": len(specs), "max_new": max_new,
+                        "value": round(t["tokens_emitted"] / res["wall"], 1),
+                        "unit": "tokens/sec",
+                        "spec_tokens_per_step": (round(tps, 3)
+                                                 if tps else None),
+                        "spec_accept_ratio": (
+                            round(t.get("spec_accept_rate", 0.0), 3)
+                            if mode != "off" and "spec_accept_rate" in t
+                            else None),
+                        "adaptive_k": t.get("spec_k"),
+                        "greedy_parity": parity,
+                        "goodput_tokens": t["goodput_tokens"],
+                        "wasted_tokens": t["wasted_tokens"],
+                    }
+                    rows.append(row)
+                finally:
+                    dec.close()
+    # int8 compose: quantized target + quantized drafter, greedy parity
+    # against the INT8 one-shot baseline (the dense slot engine's int8
+    # token chain, reproduced by the paged engine with spec on)
+    specs = _workload(seed, min(requests_n, 4), max_new, False)
+    base = PagedBatchingDecoder(m, coherent, slots=2,
+                                chunk_steps=chunk_steps,
+                                page_tokens=page_tokens, quantize="int8")
+    dec = PagedBatchingDecoder(m, coherent, slots=2, chunk_steps=chunk_steps,
+                               page_tokens=page_tokens, quantize="int8",
+                               spec="self", spec_exit_layer=EXIT_LAYER,
+                               spec_k=spec_k, spec_adaptive=False)
+    try:
+        want = [o["tokens"][0] for o in _drive(base, specs)["outs"]]
+        res = _drive(dec, specs)
+        got = [o["tokens"][0] for o in res["outs"]]
+        t = res["telemetry"]
+        parity = got == want
+        ok = ok and parity
+        rows.append({
+            "metric": "spec-decode-serving", "mode": "self-int8",
+            "batch": 1, "sampling": "greedy", "spec_k": spec_k,
+            "requests": len(specs), "max_new": max_new,
+            "value": round(t["tokens_emitted"] / res["wall"], 1),
+            "unit": "tokens/sec",
+            "spec_tokens_per_step": round(
+                t["tokens_emitted"] / t["device_steps"], 3),
+            "spec_accept_ratio": round(t.get("spec_accept_rate", 0.0), 3),
+            "greedy_parity": parity,
+        })
+    finally:
+        base.close()
+        dec.close()
+    return rows, ok, (m, coherent)
+
+
+def scrape_ps(module, variables, spec_k: int) -> dict:
+    """Boot a REAL PS HTTP surface serving the coherent-tail checkpoint
+    with KUBEML_SERVING_SPEC=self, run one generate, and scrape /metrics
+    over HTTP — the acceptance counters must be live on the exposition."""
+    import os
+    import socket
+    import tempfile
+
+    import jax
+    import requests as rq
+
+    from ..api.config import Config
+    from ..api.types import GenerateRequest
+    from ..functions.registry import FunctionRegistry
+    from ..ps.parameter_server import ParameterServer
+    from ..ps.transport import PSAPI
+    from ..storage.checkpoint import FINAL_TAG, CheckpointStore
+
+    def fp():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    root = tempfile.mkdtemp(prefix="kubeml-spec-")
+    os.environ.setdefault("KUBEML_DATA_ROOT", root)
+    cfg = Config(data_root=__import__("pathlib").Path(root), ps_port=fp(),
+                 serving_slots=2, serving_chunk_steps=4,
+                 serving_page_tokens=8, serving_spec="self",
+                 spec_k=spec_k, spec_exit_layer=EXIT_LAYER,
+                 spec_adaptive=False)
+    cfg.ensure_dirs()
+    fn_src = (
+        "import optax\n"
+        "from kubeml_tpu.runtime.model import KubeModel\n"
+        "from kubeml_tpu.data.dataset import KubeDataset\n"
+        "from kubeml_tpu.models.gpt import CausalTransformer\n"
+        "class D(KubeDataset):\n"
+        "    def __init__(self):\n"
+        "        super().__init__('unused')\n"
+        "class Model(KubeModel):\n"
+        "    def __init__(self):\n"
+        "        super().__init__(D())\n"
+        "    def build(self):\n"
+        f"        return CausalTransformer(vocab_size={VOCAB}, "
+        f"max_len={MAX_LEN}, embed_dim=64, depth={DEPTH}, num_heads=4)\n"
+        "    def configure_optimizers(self):\n"
+        "        return optax.adamw(self.lr)\n")
+    import flax.linen as nn
+
+    reg = FunctionRegistry(config=cfg)
+    reg.create("specfn", fn_src)
+    CheckpointStore(config=cfg).save(
+        "specjob", jax.tree.map(np.asarray, nn.meta.unbox(variables)),
+        epoch=1, tag=FINAL_TAG,
+        meta={"request": {"function_name": "specfn"}})
+    ps = ParameterServer(registry=reg, config=cfg)
+    api = PSAPI(ps, config=cfg).start()
+    try:
+        out = ps.generate("specjob", GenerateRequest(
+            prompts=[[1, 2, 3, 4, 5, 6, 7, 8]], max_new_tokens=16))
+        text = rq.get(f"{api.url}/metrics", timeout=60).text
+        found = {name: None for name in (
+            "kubeml_serving_spec_drafted_tokens_total",
+            "kubeml_serving_spec_proposed_tokens_total",
+            "kubeml_serving_spec_accepted_tokens_total",
+            "kubeml_serving_spec_accept_rate")}
+        for line in text.splitlines():
+            for name in found:
+                if line.startswith(name + "{"):
+                    found[name] = float(line.rsplit(" ", 1)[1])
+        live = all(v is not None and v > 0 for v in found.values())
+        return {"metric": "spec-decode-ps-scrape", "live": live,
+                "counters": found,
+                "payload_spec_accepted": out.get("spec_accepted_tokens"),
+                "payload_spec_proposed": out.get("spec_proposed_tokens")}
+    finally:
+        api.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="speculative-decoding serving proof (CPU-measurable)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--requests", type=int, default=8)
+    p.add_argument("--max-new", type=int, default=24)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--chunk-steps", type=int, default=8)
+    p.add_argument("--page-tokens", type=int, default=8)
+    p.add_argument("--spec-k", type=int, default=4)
+    p.add_argument("--out", default=None,
+                   help="append the JSON rows here (e.g. "
+                        "results/spec_decode.jsonl)")
+    p.add_argument("--skip-scrape", action="store_true",
+                   help="skip the PS /metrics HTTP scrape row")
+    args = p.parse_args(argv)
+
+    rows, parity_ok, (module, coherent) = run_rows(
+        args.seed, args.requests, args.max_new, args.slots,
+        args.chunk_steps, args.page_tokens, args.spec_k)
+    if not args.skip_scrape:
+        rows.append(scrape_ps(module, coherent, args.spec_k))
+
+    self_b1 = next(r for r in rows if r["mode"] == "self"
+                   and r["batch"] == 1 and r["sampling"] == "greedy")
+    gate = {
+        "metric": "spec-decode-gate",
+        "greedy_parity": parity_ok,
+        "self_batch1_tokens_per_step": self_b1["spec_tokens_per_step"],
+        "tokens_per_step_gt_1": self_b1["spec_tokens_per_step"] > 1.0,
+        "scrape_live": next((r["live"] for r in rows
+                             if r["metric"] == "spec-decode-ps-scrape"),
+                            None),
+    }
+    gate["pass"] = bool(parity_ok and gate["tokens_per_step_gt_1"]
+                        and gate["scrape_live"] is not False)
+    rows.append(gate)
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    if args.out:
+        with open(args.out, "a") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+    return 0 if gate["pass"] else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -637,7 +637,7 @@ def cmd_top(args) -> int:
             print("\x1b[2J\x1b[H", end="")  # clear + home
         print(time.strftime("kubeml top — %H:%M:%S  ")
               + f"(window {hist.get('stats_window', '?')}s)")
-        cols = ("MODEL", "TOK/S", "QUEUE", "OCC", "PAGES", "GOODPUT",
+        cols = ("MODEL", "TOK/S", "QUEUE", "OCC", "PAGES", "SPEC", "GOODPUT",
                 "DEAD/S", "TTFT-P99", "429/S")
         rows = []
         for m in models:
@@ -653,6 +653,9 @@ def cmd_top(args) -> int:
                 # dense slot engine, which has no page pool)
                 fmt(metric(series, "kubeml_serving_page_occupancy", m,
                            "mean", "latest")),
+                # speculative acceptance rate ("-" until a spec step ran)
+                fmt(metric(series, "kubeml_serving_spec_accept_rate", m,
+                           "latest")),
                 fmt(metric(series, "kubeml_serving_goodput_ratio", m,
                            "latest")),
                 fmt(metric(series,

@@ -60,6 +60,17 @@ class GenerateResult(NamedTuple):
     lengths: jnp.ndarray  # [B] int32 — tokens generated incl. EOS (or the cap)
 
 
+class SpecGenerateResult(NamedTuple):
+    """A speculative run's result + its acceptance accounting."""
+
+    tokens: jnp.ndarray    # [B, max_new_tokens] int32; PAD_ID padded
+    lengths: jnp.ndarray   # [B] int32
+    proposed: int          # candidate tokens verified (k + 1 per live row/step)
+    accepted: int          # drafted tokens that passed acceptance
+    drafted: int           # tokens the drafter sampled (k per live row/step)
+    steps: int             # verify macro-steps executed
+
+
 def init_cache(module, variables, batch: int) -> dict:
     """A zeroed KV-cache pytree for ``batch`` rows (cursor at 0).
 
@@ -128,6 +139,173 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int]):
         kth = jax.lax.top_k(logits, top_k)[0][:, -1:]  # [B, 1]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding (Leviathan et al. 2023; Chen et al. 2023): a cheap
+# drafter proposes k tokens, the target verifies all k+1 positions in ONE
+# forward, and the canonical rejection-sampling rule keeps the emitted
+# stream EXACTLY target-distributed (greedy: bit-identical to the baseline
+# argmax chain). The traced helpers below are shared by the one-shot
+# ``make_speculative_generate_fn`` and the serving engine's spec mode
+# (serving/batcher.py) so the acceptance math exists exactly once.
+# ---------------------------------------------------------------------------
+
+# static width of the on-device top-k scratch for runtime per-row knobs —
+# mirrors serving.batcher.TOP_K_MAX (the wire cap); kept here so the
+# acceptance math has no serving-layer import
+SPEC_TOP_K_CAP = 128
+
+_SPEC_NEG_INF = jnp.finfo(jnp.float32).min
+
+# fold_in indices the acceptance draws consume — far outside the
+# small-integer per-draft-position folds callers use on the same keys
+_ACCEPT_FOLD = 7919
+_CORRECTION_FOLD = 7927
+
+
+def _masked_scaled(logits, temp, topk, topk_cap: int = SPEC_TOP_K_CAP):
+    """Per-row knob-adjusted logits: temperature scaling + top-k truncation
+    with RUNTIME knobs. logits [S, V] f32, temp [S] (<=0 rows produce junk
+    the greedy branch discards), topk [S] i32 (0 = off)."""
+    V = logits.shape[-1]
+    scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
+    kwide = min(topk_cap, V)
+    vals = jax.lax.top_k(scaled, kwide)[0]  # [S, kwide] sorted desc
+    kth = jnp.take_along_axis(
+        vals, jnp.clip(topk - 1, 0, kwide - 1)[:, None], axis=1)  # [S, 1]
+    return jnp.where((topk > 0)[:, None] & (scaled < kth),
+                     _SPEC_NEG_INF, scaled)
+
+
+def _knob_probs(logits, temp, topk, topk_cap: int = SPEC_TOP_K_CAP):
+    """The actual per-row SAMPLING DISTRIBUTION under runtime knobs —
+    softmax over the temperature-scaled, top-k-truncated logits. This is
+    the p (target) and q (drafter) the acceptance rule compares, so it must
+    match what a categorical draw over ``_masked_scaled`` samples from
+    (it does: softmax is shift-invariant, categorical is softmax-implicit)."""
+    return jax.nn.softmax(_masked_scaled(logits, temp, topk, topk_cap),
+                          axis=-1)
+
+
+def draft_sample(logits, temp, topk, keys, topk_cap: int = SPEC_TOP_K_CAP):
+    """One drafter draw per row with runtime knobs: greedy rows take the
+    argmax, sampled rows draw categorically. Returns ``(tokens [S],
+    probs [S, V])`` — probs is the drafter's knob-adjusted distribution q,
+    recorded for the acceptance test (greedy rows' probs are unused: their
+    acceptance is exact argmax equality)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    masked = _masked_scaled(logits, temp, topk, topk_cap)
+    drawn = jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
+    toks = jnp.where(temp <= 0.0, greedy, drawn)
+    return toks, _knob_probs(logits, temp, topk, topk_cap)
+
+
+def spec_accept(tgt_logits, draft_tokens, draft_probs, temp, topk, keys,
+                topk_cap: int = SPEC_TOP_K_CAP):
+    """The distribution-preserving acceptance rule, vectorized per row.
+
+    ``tgt_logits`` [S, k+1, V] f32 — the verify forward's logits at the
+    k+1 positions (position i is the distribution AFTER feeding draft i-1;
+    position 0 follows the row's current token). ``draft_tokens`` [S, k],
+    ``draft_probs`` [S, k, V] (the drafter's q at each position), ``temp``
+    [S], ``topk`` [S], ``keys`` [S, 2] — fresh per-row use-keys; draws
+    consume ``fold_in(key, _ACCEPT_FOLD)`` (uniforms) and
+    ``fold_in(key, _CORRECTION_FOLD)`` (the correction categorical) —
+    indices far outside the small-integer range callers use for their
+    per-draft-position folds, so no stream is ever reused.
+
+    Greedy rows (temp <= 0): draft i accepted iff it IS the target argmax
+    at position i — the emitted stream is bit-identical to the baseline
+    argmax chain. Sampled rows: accept draft d_i with prob
+    min(1, p_i(d_i) / q_i(d_i)); at the first rejection resample from the
+    normalized residual max(p - q, 0) (the exact Leviathan correction);
+    if all k drafts survive, the bonus token samples from p_k. Returns
+    ``(emit [S, k+1] — accepted drafts then the correction/bonus, -1
+    past it; n_acc [S] — accepted draft count in [0, k])``."""
+    S, k1, V = tgt_logits.shape
+    k = k1 - 1
+    greedy_row = temp <= 0.0
+    tgt_arg = jnp.argmax(tgt_logits, axis=-1).astype(jnp.int32)  # [S, k+1]
+    p = jax.vmap(lambda lg: _knob_probs(lg, temp, topk, topk_cap),
+                 in_axes=1, out_axes=1)(tgt_logits)  # [S, k+1, V]
+    if k > 0:
+        p_d = jnp.take_along_axis(
+            p[:, :k], draft_tokens[..., None], axis=-1)[..., 0]  # [S, k]
+        q_d = jnp.take_along_axis(
+            draft_probs, draft_tokens[..., None], axis=-1)[..., 0]
+        u = jax.vmap(lambda kk: jax.random.uniform(
+            jax.random.fold_in(kk, _ACCEPT_FOLD), (k,)))(keys)  # [S, k]
+        # u < min(1, p/q)  <=>  u * q < p  (u < 1, so p >= q always accepts)
+        acc = jnp.where(greedy_row[:, None],
+                        tgt_arg[:, :k] == draft_tokens,
+                        u * q_d < p_d)
+        # leading-run length: drafts past the first rejection never count
+        n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+    else:
+        n_acc = jnp.zeros((S,), jnp.int32)
+    # the correction/bonus position: first rejected draft index, or k
+    j = n_acc[:, None, None]
+    p_j = jnp.take_along_axis(p, jnp.broadcast_to(j, (S, 1, V)),
+                              axis=1)[:, 0]  # [S, V]
+    if k > 0:
+        jq = jnp.minimum(n_acc, k - 1)[:, None, None]
+        q_j = jnp.take_along_axis(draft_probs,
+                                  jnp.broadcast_to(jq, (S, 1, V)),
+                                  axis=1)[:, 0]
+        resid = jnp.maximum(p_j - q_j, 0.0)
+        rs = resid.sum(-1, keepdims=True)
+        # a rejection with an (numerically) empty residual means p ~= q —
+        # the acceptance probability was ~1, so sampling p is the limit
+        resid = jnp.where(rs > 1e-9, resid / jnp.maximum(rs, 1e-30), p_j)
+        corr_dist = jnp.where((n_acc < k)[:, None], resid, p_j)
+    else:
+        corr_dist = p_j
+    corr_keys = jax.vmap(
+        lambda kk: jax.random.fold_in(kk, _CORRECTION_FOLD))(keys)
+    drawn = jax.vmap(jax.random.categorical)(
+        corr_keys, jnp.log(jnp.maximum(corr_dist, 1e-30))).astype(jnp.int32)
+    corr_greedy = jnp.take_along_axis(tgt_arg, n_acc[:, None],
+                                      axis=1)[:, 0]
+    correction = jnp.where(greedy_row, corr_greedy, drawn)
+    idx = jnp.arange(k + 1)[None, :]
+    if k > 0:
+        drafts_wide = jnp.pad(draft_tokens, ((0, 0), (0, 1)))  # [S, k+1]
+    else:
+        drafts_wide = jnp.zeros((S, 1), jnp.int32)
+    emit = jnp.where(idx < n_acc[:, None], drafts_wide, -1)
+    emit = jnp.where(idx == n_acc[:, None], correction[:, None], emit)
+    return emit, n_acc
+
+
+def spec_mask_emissions(emit, n_acc, live, remaining, eos, tok):
+    """Clip one macro-step's raw emissions to what the row may actually
+    emit — the device-side mirror of the host routing rules, so packed
+    blocks never carry a token the host would have to un-route:
+
+    * only live rows emit; a row emits at most ``remaining`` tokens;
+    * emissions stop AFTER the first ``eos`` (the eos itself counts,
+      matching the baseline step loop and the engine's routing).
+
+    Returns ``(out [S, k+1] with -1 past the clip, n_take [S], live2 [S],
+    rem2 [S], feed [S] — the next token to feed, frozen for dead rows)``."""
+    S, k1 = emit.shape
+    idx = jnp.arange(k1)[None, :]
+    valid = (idx <= n_acc[:, None]) & (idx < remaining[:, None]) \
+        & live[:, None]
+    is_eos = (eos >= 0)[:, None] & (emit == eos[:, None]) & valid
+    eos_before = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) \
+        - is_eos.astype(jnp.int32)
+    valid = valid & (eos_before == 0)
+    n_take = valid.sum(axis=1).astype(jnp.int32)
+    out = jnp.where(valid, emit, -1)
+    hit_eos = (is_eos & valid).any(axis=1)
+    rem2 = remaining - n_take
+    live2 = live & ~hit_eos & (rem2 > 0)
+    last = jnp.take_along_axis(
+        out, jnp.clip(n_take - 1, 0, k1 - 1)[:, None], axis=1)[:, 0]
+    feed = jnp.where(live & (n_take > 0), last, tok)
+    return out, n_take, live2, rem2, feed
 
 
 def make_generate_fn(module, *, max_new_tokens: int, temperature: float = 0.0,
@@ -200,6 +378,234 @@ def make_generate_fn(module, *, max_new_tokens: int, temperature: float = 0.0,
     return run
 
 
+def make_speculative_generate_fn(module, *, max_new_tokens: int,
+                                 spec: str = "self", spec_k: int = 4,
+                                 draft_module=None,
+                                 exit_layer: Optional[int] = None,
+                                 temperature: float = 0.0,
+                                 top_k: Optional[int] = None,
+                                 eos_id: Optional[int] = None,
+                                 page_tokens: int = 16):
+    """Speculative decoding for the one-shot path: a ``(variables,
+    prompt_ids, rng, draft_variables=None) -> SpecGenerateResult`` callable.
+
+    Two drafter backends:
+
+    * ``spec="draft"`` — a separate small causal LM (``draft_module`` +
+      the call-time ``draft_variables``, e.g. loaded from its own
+      checkpoint) proposes ``spec_k`` tokens per step through its own
+      paged KV cache;
+    * ``spec="self"`` — self-drafting: logits from a TRUNCATED layer stack
+      of the target (``exit_layer`` blocks + ln_f + lm_head — no second
+      model). The drafter shares the target's paged arena: it writes
+      layers < exit_layer, and the verify forward re-writes those
+      positions with identical bytes while filling the rest.
+
+    Per step the target verifies all k+1 positions in ONE forward (the
+    paged L>1 suffix path), ``spec_accept`` applies the canonical
+    rejection rule, and rollback is positional: a rejected suffix is
+    simply overwritten by the next step's k+1-wide write window. Greedy
+    (``temperature == 0``) emits BIT-IDENTICAL tokens to the baseline
+    ``generate``; sampled decode preserves the target distribution exactly
+    (accept min(1, p/q), resample the residual).
+
+    Unlike ``make_generate_fn`` this is a host loop over one jitted
+    macro-step (the step count is data-dependent — that is the point:
+    fewer weight streams per emitted token), so each call syncs once per
+    macro-step. Serving traffic goes through the engine's spec mode
+    instead (``KUBEML_SERVING_SPEC``)."""
+    if spec not in ("self", "draft"):
+        raise ValueError(f"unknown spec backend {spec!r} "
+                         f"(valid: 'self', 'draft')")
+    if spec_k < 1:
+        raise ValueError("spec_k must be >= 1")
+    if not supports_paged_decode(module):
+        raise GenerationInputError(
+            "speculative decoding runs on the paged decode path; the module "
+            "has none (pages/seq_lens kwargs + page_tokens/kv_pages fields)")
+    if spec == "draft":
+        if draft_module is None:
+            raise ValueError("spec='draft' needs a draft_module")
+        if not supports_paged_decode(draft_module):
+            raise GenerationInputError("draft module has no paged decode path")
+        if getattr(draft_module, "vocab_size", None) != \
+                getattr(module, "vocab_size", None):
+            raise GenerationInputError(
+                "draft and target models must share one vocabulary")
+    depth = getattr(module, "depth", None)
+    if spec == "self":
+        exit_layer = int(exit_layer) if exit_layer else max(1, (depth or 2) // 2)
+        if depth is not None and not (1 <= exit_layer <= depth):
+            raise ValueError(
+                f"exit_layer must be in [1, depth={depth}], got {exit_layer}")
+    cap = getattr(module, "max_len", None)
+    if cap is None:
+        raise GenerationInputError(
+            "model exposes no max_len attribute; generation requires a "
+            "declared KV-cache capacity")
+    pt = int(page_tokens)
+    k = int(spec_k)
+    if temperature <= 0.0:
+        top_k = None  # greedy ignores top_k (normalized like generate)
+    # per-(B, Lp) compiled pieces: the cloned modules depend on the page
+    # table geometry, which depends on the call shapes
+    programs: dict = {}
+
+    def build(B: int, Lp: int):
+        total = min(Lp + max_new_tokens - 1 + k, int(cap))
+        tp = -(-total // pt)
+        npages = B * tp + 1  # page 0 reserved as trash
+        cloned = module.clone(page_tokens=pt, kv_pages=npages)
+        dcloned = (draft_module.clone(page_tokens=pt, kv_pages=npages)
+                   if spec == "draft" else None)
+        table = jnp.asarray(
+            [[1 + r * tp + j for j in range(tp)] for r in range(B)],
+            jnp.int32)
+
+        def drafter_apply(dvars, dcache, tok, pos, live):
+            kw = {"exit_layer": exit_layer} if spec == "self" else {}
+            mod = cloned if spec == "self" else dcloned
+            lg, vs = mod.apply(
+                {**dvars, "cache": dcache}, tok[:, None], decode=True,
+                positions=pos, pages=table,
+                seq_lens=jnp.where(live, 1, 0), mutable=["cache"], **kw)
+            return lg[:, -1].astype(jnp.float32), vs["cache"]
+
+        @jax.jit
+        def prefill(variables, draft_variables, prompt_ids, rng):
+            cache = init_paged_cache(cloned, variables, B, tp)
+            zeros = jnp.zeros((B,), jnp.int32)
+            plens = jnp.full((B,), Lp, jnp.int32)
+            logits, vs = cloned.apply(
+                {**variables, "cache": cache}, prompt_ids, decode=True,
+                positions=zeros, pages=table, seq_lens=plens,
+                mutable=["cache"])
+            cache = vs["cache"]
+            if spec == "draft":
+                dcache = init_paged_cache(dcloned, draft_variables, B, tp)
+                _, dvs = dcloned.apply(
+                    {**draft_variables, "cache": dcache}, prompt_ids,
+                    decode=True, positions=zeros, pages=table,
+                    seq_lens=plens, mutable=["cache"])
+                dcache = dvs["cache"]
+            else:
+                dcache = None
+            rng, r0 = jax.random.split(rng)
+            first = _sample(logits[:, -1], r0, temperature, top_k)
+            done0 = (jnp.zeros((B,), bool) if eos_id is None
+                     else first == eos_id)
+            live = jnp.full((B,), max_new_tokens > 1) & ~done0
+            rem = jnp.full((B,), max_new_tokens - 1, jnp.int32)
+            return (cache, dcache, first, plens, live, rem, rng)
+
+        @jax.jit
+        def step(variables, draft_variables, carry):
+            cache, dcache, tok, pos, live, rem, rng = carry
+            rng, use = jax.random.split(rng)
+            row_keys = jax.vmap(
+                lambda b: jax.random.fold_in(use, b))(jnp.arange(B))
+            temps = jnp.full((B,), float(temperature), jnp.float32)
+            topks = jnp.full((B,), int(top_k or 0), jnp.int32)
+            eoss = jnp.full((B,), eos_id if eos_id is not None else -1,
+                            jnp.int32)
+            dvars = draft_variables if spec == "draft" else variables
+            dc0 = dcache if spec == "draft" else cache
+
+            def dr(c2, i):
+                dc, t, p = c2
+                lg, dc = drafter_apply(dvars, dc, t, p, live)
+                dk = jax.vmap(jax.random.fold_in)(
+                    row_keys, jnp.full((B,), i))
+                d_i, q_i = draft_sample(lg, temps, topks, dk)
+                return (dc, d_i, p + 1), (d_i, q_i)
+
+            # draft mode runs ONE extra write-only iteration: the k-th
+            # draft is fed to the verify pass but the drafter's own cache
+            # must also hold its K/V, or a fully-accepted step leaves a
+            # permanent zero-KV gap at that position and every later draft
+            # distribution degrades. Self mode skips it — the verify
+            # forward re-writes the shared arena wholesale.
+            iters = k + 1 if spec == "draft" else k
+            (dc_out, _, _), (d, q_probs) = jax.lax.scan(
+                dr, (dc0, tok, pos), jnp.arange(iters))
+            drafts = d.T[:, :k]  # [B, k]
+            q_probs = jnp.moveaxis(q_probs, 0, 1)[:, :k]  # [B, k, V]
+            vcache = dc_out if spec == "self" else cache
+            vt = jnp.concatenate([tok[:, None], drafts], axis=1)  # [B, k+1]
+            vlg, vs = cloned.apply(
+                {**variables, "cache": vcache}, vt, decode=True,
+                positions=pos, pages=table,
+                seq_lens=jnp.where(live, k + 1, 0), mutable=["cache"])
+            cache2 = vs["cache"]
+            dcache2 = dc_out if spec == "draft" else None
+            emit, n_acc = spec_accept(vlg.astype(jnp.float32), drafts,
+                                      q_probs, temps, topks, row_keys)
+            out, n_take, live2, rem2, feed = spec_mask_emissions(
+                emit, n_acc, live, rem, eoss, tok)
+            pos2 = jnp.where(live, pos + n_take, pos)
+            stats = jnp.stack([
+                jnp.where(live, k, 0).sum(),
+                jnp.where(live, n_acc, 0).sum(),
+            ])
+            return (cache2, dcache2, feed, pos2, live2, rem2, rng), out, stats
+
+        return prefill, step
+
+    def run(variables, prompt_ids, rng=None,
+            draft_variables=None) -> SpecGenerateResult:
+        import numpy as np
+
+        if temperature > 0.0 and rng is None:
+            raise GenerationInputError(
+                "temperature > 0 requires an explicit rng (PRNGKey)")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        if spec == "draft" and draft_variables is None:
+            raise GenerationInputError("spec='draft' needs draft_variables")
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        B, Lp = prompt_ids.shape
+        if Lp + max_new_tokens - 1 > cap:
+            raise GenerationInputError(
+                f"prompt ({Lp}) + max_new_tokens ({max_new_tokens}) - 1 "
+                f"exceeds the model's max_len ({cap})")
+        dcap = (getattr(draft_module, "max_len", None)
+                if spec == "draft" else cap)
+        if dcap is not None and Lp + max_new_tokens - 1 > dcap:
+            raise GenerationInputError(
+                f"draft model's max_len ({dcap}) cannot cover the request")
+        key = (B, Lp)
+        if key not in programs:
+            programs[key] = build(B, Lp)
+        prefill, step = programs[key]
+        carry = prefill(variables, draft_variables, prompt_ids, rng)
+        outs = [[int(np.asarray(carry[2])[b])] for b in range(B)]
+        proposed = accepted = drafted = steps = 0
+        live = np.asarray(carry[4])
+        while live.any() and steps < max_new_tokens:
+            carry, packed, stats = step(variables, draft_variables, carry)
+            packed = np.asarray(packed)  # [B, k+1]; -1 past the clip
+            n_live = int(live.sum())
+            for b in range(B):
+                for t in packed[b]:
+                    if t < 0:
+                        break
+                    outs[b].append(int(t))
+            d, a = (int(v) for v in np.asarray(stats))
+            drafted += d
+            accepted += a
+            proposed += d + n_live  # + the bonus position per live row
+            steps += 1
+            live = np.asarray(carry[4])
+        lengths = jnp.asarray([len(o) for o in outs], jnp.int32)
+        tokens = jnp.asarray(
+            [o + [PAD_ID] * (max_new_tokens - len(o)) for o in outs],
+            jnp.int32)
+        return SpecGenerateResult(tokens, lengths, proposed, accepted,
+                                  drafted, steps)
+
+    return run
+
+
 # LRU of (module, knobs) -> jitted fn. Keyed by the module itself when
 # hashable (flax modules are frozen dataclasses, so equal configs share one
 # program even across fresh instances); falls back to id() for modules with
@@ -223,7 +629,10 @@ def _cache_key(module, knobs):
 def generate(module, variables, prompt_ids, *, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
              eos_id: Optional[int] = None,
-             rng: Optional[jax.Array] = None) -> GenerateResult:
+             rng: Optional[jax.Array] = None,
+             spec: str = "", spec_k: int = 4,
+             draft_module=None, draft_variables=None,
+             spec_exit_layer: Optional[int] = None) -> GenerateResult:
     """Sample ``max_new_tokens`` continuations of ``prompt_ids`` [B, Lp].
 
     Greedy when ``temperature == 0`` (default); ``temperature > 0`` REQUIRES
@@ -240,6 +649,13 @@ def generate(module, variables, prompt_ids, *, max_new_tokens: int,
     device rate — 3,513 tokens/sec for the 124M class through the dev
     tunnel). For a long-lived serving loop, hold your own
     ``make_generate_fn`` result instead.
+
+    ``spec`` ("self" | "draft") routes through speculative decoding
+    (``make_speculative_generate_fn``); the drafter IDENTITY and depth are
+    part of the jit-cache key — toggling spec modes, changing ``spec_k`` /
+    ``spec_exit_layer``, or swapping draft modules can never serve a stale
+    compiled program (draft WEIGHTS are call arguments, draft architecture
+    is the keyed identity).
     """
     if temperature > 0.0 and rng is None:
         raise GenerationInputError(
@@ -251,7 +667,20 @@ def generate(module, variables, prompt_ids, *, max_new_tokens: int,
         top_k = None  # greedy ignores top_k — normalizing the key keeps
         # byte-identical programs from compiling (and caching) twice
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
-    key = _cache_key(module, (max_new_tokens, float(temperature), top_k, eos_id))
+    # the drafter's identity rides the cache key: the draft module itself
+    # when hashable (equal configs share a program), else its id — and the
+    # cache entry holds the ref so the id can't be recycled
+    if spec:
+        try:
+            hash(draft_module)
+            draft_id = draft_module
+        except TypeError:
+            draft_id = id(draft_module)
+        spec_knobs = (spec, int(spec_k), spec_exit_layer, draft_id)
+    else:
+        spec_knobs = ("", 0, None, None)
+    key = _cache_key(module, (max_new_tokens, float(temperature), top_k,
+                              eos_id, *spec_knobs))
     with _GENERATE_CACHE_LOCK:
         entry = _GENERATE_CACHE.get(key)  # hit: non-destructive recency bump
         if entry is not None:
@@ -259,16 +688,26 @@ def generate(module, variables, prompt_ids, *, max_new_tokens: int,
     if entry is None:
         # build outside the lock (the jit wrapper is cheap; compilation is
         # lazy at call time); setdefault keeps one winner under a race
-        fn = make_generate_fn(module, max_new_tokens=max_new_tokens,
-                              temperature=temperature, top_k=top_k,
-                              eos_id=eos_id)
+        if spec:
+            fn = make_speculative_generate_fn(
+                module, max_new_tokens=max_new_tokens, spec=spec,
+                spec_k=spec_k, draft_module=draft_module,
+                exit_layer=spec_exit_layer, temperature=temperature,
+                top_k=top_k, eos_id=eos_id)
+        else:
+            fn = make_generate_fn(module, max_new_tokens=max_new_tokens,
+                                  temperature=temperature, top_k=top_k,
+                                  eos_id=eos_id)
         with _GENERATE_CACHE_LOCK:
-            # the value holds the module ref too: for the id()-keyed fallback
-            # the id must not be recycled while the entry lives
-            entry = _GENERATE_CACHE.setdefault(key, (module, fn))
+            # the value holds the module refs too: for the id()-keyed
+            # fallback the ids must not be recycled while the entry lives
+            entry = _GENERATE_CACHE.setdefault(key, (module, fn, draft_module))
             _GENERATE_CACHE.move_to_end(key)
             while len(_GENERATE_CACHE) > _GENERATE_CACHE_MAX:
                 _GENERATE_CACHE.popitem(last=False)  # least recent
+    if spec:
+        out = entry[1](variables, prompt_ids, rng, draft_variables)
+        return GenerateResult(out.tokens, out.lengths)
     return entry[1](variables, prompt_ids, rng)
 
 

@@ -141,6 +141,13 @@ class CausalSelfAttention(nn.Module):
                 wvalid = (jnp.arange(L)[None, :] < seq_lens[:, None]
                           if seq_lens is not None
                           else valid.astype(jnp.bool_))
+                # writes past the row table's addressable range go to the
+                # trash page, NOT clamped onto the last logical page (the
+                # page_idx clip below would otherwise scatter a speculative
+                # lookahead overflow over live data). Only emissions the
+                # engine masks anyway can involve such positions, so
+                # trash-redirecting them is exact.
+                wvalid = wvalid & (pos_full < tw * pt)
                 page_idx = jnp.clip(pos_full // pt, 0, tw - 1)
                 phys = jnp.take_along_axis(pages, page_idx, axis=1)  # [B, L]
                 phys = jnp.where(wvalid, phys, 0)
@@ -342,7 +349,15 @@ class CausalTransformer(nn.Module):
     @nn.compact
     def __call__(self, token_ids, train: bool = False, decode: bool = False,
                  return_hidden: bool = False, positions=None, pages=None,
-                 seq_lens=None):
+                 seq_lens=None, exit_layer: Optional[int] = None):
+        # ``exit_layer`` (a TRACE-TIME int in [1, depth]) runs only the
+        # first ``exit_layer`` blocks, then ln_f + lm_head — the early-exit
+        # self-drafting head for speculative decoding (models.generation /
+        # serving spec mode). Untouched blocks' cache variables pass through
+        # the mutable collection unchanged, so a truncated drafter forward
+        # and the full verify forward share one paged arena: the drafter
+        # writes layers < exit_layer, the verify re-writes them with
+        # identical bytes and fills the rest.
         token_ids = token_ids.astype(jnp.int32)
         B, L = token_ids.shape
         if decode:
@@ -402,7 +417,16 @@ class CausalTransformer(nn.Module):
             # layer probes this and falls back to the dense engine
             raise ValueError("paged decode does not cover MoE-interleaved "
                              "models; serve them through the dense cache")
-        for i in range(self.depth):
+        if exit_layer is not None:
+            if not (1 <= int(exit_layer) <= self.depth):
+                raise ValueError(
+                    f"exit_layer must be in [1, depth={self.depth}], got "
+                    f"{exit_layer}")
+            if self.moe_every > 0:
+                raise ValueError("early-exit drafting does not cover "
+                                 "MoE-interleaved models")
+        run_depth = self.depth if exit_layer is None else int(exit_layer)
+        for i in range(run_depth):
             if self.moe_every > 0 and (i + 1) % self.moe_every == 0:
                 from ..parallel.moe import MoEBlock
 

@@ -201,6 +201,20 @@ SERVING_COUNTERS = {
     "kubeml_serving_prefix_tokens_saved_total": (
         "prefix_tokens_saved", "Prompt tokens whose prefill was skipped "
                                "because their KV pages were prefix-cached"),
+    # speculative decoding (paged engine spec mode, serving/batcher.py —
+    # series exist only once a spec step ran)
+    "kubeml_serving_spec_drafted_tokens_total": (
+        "spec_drafted_tokens", "Tokens the speculative drafter sampled "
+                               "(k per live row per verify step)"),
+    "kubeml_serving_spec_proposed_tokens_total": (
+        "spec_proposed_tokens", "Candidate emissions submitted to one-pass "
+                                "batched verification (drafts + the bonus "
+                                "position per live row)"),
+    "kubeml_serving_spec_accepted_tokens_total": (
+        "spec_accepted_tokens", "Drafted tokens the rejection-sampling "
+                                "acceptance rule kept"),
+    "kubeml_serving_spec_steps_total": (
+        "spec_steps", "Speculative verify macro-steps processed"),
 }
 # per-job latency histograms (no reference counterpart — the gauges above
 # keep only the LAST epoch's value). Fed from MetricUpdate; series OUTLIVE
@@ -262,6 +276,9 @@ SERVING_HISTOGRAMS = {
                      "drained rows)"),
     "kubeml_serving_batch_occupancy_ratio": (
         "occupancy_ratio", "Per-chunk live fraction of device slot-steps"),
+    "kubeml_serving_spec_accept_ratio": (
+        "spec_accept_ratio", "Per-verify-step speculative acceptance ratio "
+                             "(accepted / drafted)"),
 }
 
 SERVING_GAUGES = {
@@ -324,6 +341,13 @@ SERVING_GAUGES = {
     "kubeml_serving_prefix_cache_pages": (
         "prefix_cache_pages", "Pages currently held by the shared-prefix "
                               "trie (evictable when unreferenced)"),
+    # speculative decoding (spec-mode decoders only)
+    "kubeml_serving_spec_accept_rate": (
+        "spec_accept_rate", "Lifetime speculative acceptance rate "
+                            "(accepted / drafted tokens)"),
+    "kubeml_serving_spec_k": (
+        "spec_k", "Current adaptive speculation depth (0 = retreated to "
+                  "plain decode pending a re-probe)"),
 }
 
 

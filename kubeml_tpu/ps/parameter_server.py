@@ -1344,12 +1344,25 @@ class ParameterServer:
 
         if (self.cfg.serving_paged and mesh is None
                 and supports_paged_decode(module)):
-            decoder = PagedBatchingDecoder(
-                module, variables,
-                page_tokens=self.cfg.serving_page_tokens,
-                pages=self.cfg.serving_pages,
-                prefix_cache=self.cfg.serving_prefix_cache,
-                **common)
+            paged_kw = dict(page_tokens=self.cfg.serving_page_tokens,
+                            pages=self.cfg.serving_pages,
+                            prefix_cache=self.cfg.serving_prefix_cache)
+            spec_kw = self._spec_decoder_args(module)
+            try:
+                decoder = PagedBatchingDecoder(module, variables,
+                                               **paged_kw, **spec_kw,
+                                               **common)
+            except Exception as e:
+                # the degrade-to-plain contract covers constructor-time
+                # rejections too (exit layer out of range, incompatible
+                # draft model, bad k): serving the checkpoint beats
+                # serving a 500 on every request
+                if not spec_kw:
+                    raise
+                log.warning("speculative-decoding config rejected (%s); "
+                            "serving %s without speculation", e, model_id)
+                decoder = PagedBatchingDecoder(module, variables,
+                                               **paged_kw, **common)
         else:
             decoder = BatchingDecoder(module, variables, mesh=mesh, **common)
         stale = []
@@ -1377,6 +1390,51 @@ class ParameterServer:
             except Exception:
                 log.exception("retiring stale decoder failed")
         return decoder
+
+    def _spec_decoder_args(self, module) -> dict:
+        """Speculative-decoding constructor args for a paged decoder, from
+        the process config (KUBEML_SERVING_SPEC=draft|self|off). A broken
+        spec configuration (unknown mode, missing/unloadable/incompatible
+        draft model) DEGRADES to plain decode with a warning — serving the
+        checkpoint beats serving a 500."""
+        spec = (self.cfg.serving_spec or "off").lower()
+        if spec in ("", "off"):
+            return {}
+        if spec not in ("draft", "self"):
+            log.warning("KUBEML_SERVING_SPEC=%r not recognized (valid: "
+                        "off, draft, self) — serving without speculation",
+                        spec)
+            return {}
+        out = dict(spec=spec, spec_k=self.cfg.spec_k,
+                   spec_adaptive=self.cfg.spec_adaptive)
+        if spec == "self":
+            out["spec_exit_layer"] = self.cfg.spec_exit_layer
+            return out
+        draft_id = self.cfg.spec_draft_model
+        if not draft_id:
+            log.warning("KUBEML_SERVING_SPEC=draft needs "
+                        "KUBEML_SPEC_DRAFT_MODEL (a finished job id); "
+                        "serving without speculation")
+            return {}
+        try:
+            # the draft checkpoint rides the same serving loader as the
+            # target: final-int8 preferred under int8 serving, so the
+            # drafter streams quantized weights too
+            from ..models.generation import supports_paged_decode
+
+            dmodel, dvars, _, dmesh = self._load_serving(draft_id)
+            dmod = getattr(dmodel, "module", None)
+            if dmod is None or dmesh is not None \
+                    or not supports_paged_decode(dmod):
+                raise KubeMLError(
+                    f"draft model {draft_id!r} cannot draft (no paged "
+                    f"decode path, or meshed)", 400)
+            out.update(draft_module=dmod, draft_variables=dvars)
+            return out
+        except Exception as e:
+            log.warning("loading the draft model %r failed (%s); serving "
+                        "without speculation", draft_id, e)
+            return {}
 
     def _infer_from_socket(self, model_id: str, record, data) -> Optional[list]:
         """Serve a live standalone job from its runner's tensor socket; None

@@ -10,11 +10,16 @@ concurrent requests into one slot-based batched decode loop;
 :class:`PagedBatchingDecoder` (the default for capable models) replaces the
 per-row ``[max_len, H, D]`` cache stripes with a paged KV arena + block
 allocator (serving/kvpool.py): page-budget admission at every chunk edge
-and shared-prefix reuse across requests.
+and shared-prefix reuse across requests. Speculative decoding
+(KUBEML_SERVING_SPEC, serving/spec.py + the acceptance math in
+models/generation.py) rides the paged engine: a drafter proposes k
+tokens, the target verifies them in one forward, and rollback is a
+positional paged-cache operation.
 """
 
 from .batcher import BatchingDecoder, DecoderClosed, PagedBatchingDecoder
 from .kvpool import KVPool, PageLease, PrefixTrie
+from .spec import AdaptiveK
 
 __all__ = ["BatchingDecoder", "PagedBatchingDecoder", "DecoderClosed",
-           "KVPool", "PageLease", "PrefixTrie"]
+           "KVPool", "PageLease", "PrefixTrie", "AdaptiveK"]

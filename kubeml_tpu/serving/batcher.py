@@ -63,9 +63,6 @@ TOP_K_MAX = 128
 # lives in one place instead of two drifting literals
 DEFAULT_SLOTS = 8
 
-_F32_NEG_INF = jnp.finfo(jnp.float32).min
-
-
 class DecoderClosed(KubeMLError):
     def __init__(self):
         super().__init__("decoder is shut down", 503)
@@ -159,18 +156,16 @@ def _sample_rows(logits, keys, temp, topk, active=None):
     are runtime data), but the sampling branch runs under ``lax.cond`` so a
     step whose ACTIVE rows are all greedy skips the vocab-wide top-k sort +
     categorical draw — on a 32k vocab that work is a real per-step tax the
-    argmax path shouldn't pay."""
-    V = logits.shape[-1]
+    argmax path shouldn't pay. The knob-adjusted logits come from the ONE
+    shared definition (``models.generation._masked_scaled``) the
+    speculative acceptance rule also samples against — the distributions
+    must be the same object, not two copies kept in sync."""
+    from ..models.generation import _masked_scaled
+
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     def draw(_):
-        scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
-        kwide = min(TOP_K_MAX, V)
-        vals = jax.lax.top_k(scaled, kwide)[0]  # [S, kwide] sorted desc
-        kth = jnp.take_along_axis(
-            vals, jnp.clip(topk - 1, 0, kwide - 1)[:, None], axis=1)  # [S, 1]
-        masked = jnp.where((topk > 0)[:, None] & (scaled < kth),
-                           _F32_NEG_INF, scaled)
+        masked = _masked_scaled(logits, temp, topk, TOP_K_MAX)
         return jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
 
     hot = temp > 0.0
@@ -232,6 +227,10 @@ class _Row:
     lease: Optional[object] = None  # kvpool.PageLease while pages are held
     prefix_cached: int = 0          # prompt tokens served from the prefix trie
     dispatched: int = 0             # post-admit steps already in the chain
+    # speculative decoding (spec mode): candidate tokens this row sent
+    # through batched verification, and drafted tokens accepted
+    spec_proposed: int = 0
+    spec_accepted: int = 0
     # lifecycle timeline (monotonic; 0 = not reached): slot assignment,
     # first/last token landing on the host — the phase-histogram feeds
     slot_at: float = 0.0
@@ -274,6 +273,13 @@ class _Entry:
                 # (summed across the request's rows; 0 on the dense engine
                 # or with KUBEML_SERVING_PREFIX_CACHE off)
                 "prefix_cached_tokens": sum(r.prefix_cached
+                                            for r in self.rows),
+                # speculative decoding attribution (0 with spec off):
+                # candidate tokens verified for this request's rows, and
+                # drafted tokens the acceptance rule kept
+                "spec_proposed_tokens": sum(r.spec_proposed
+                                            for r in self.rows),
+                "spec_accepted_tokens": sum(r.spec_accepted
                                             for r in self.rows)}
 
 
@@ -1362,6 +1368,15 @@ class BatchingDecoder:
         self.stats.chunk_occupancy(
             T, live_steps, dead_steps, T * S - live_steps - dead_steps,
             capacity=S)
+        self._route_chunk_tokens(packed, snapshot)
+
+    def _route_chunk_tokens(self, packed, snapshot) -> None:
+        """Route one packed [T, S] emission block to its rows (shared by
+        the plain chunk path and the paged engine's spec records): fresh
+        tokens append in order, -1 ends a row's block, eos/max_new close
+        the row, and tokens for an already-done row count as waste so
+        goodput + wasted stays the exact partition of every emitted
+        token."""
         for slot, row in enumerate(snapshot):
             if row is None:
                 continue
@@ -1551,7 +1566,11 @@ class PagedBatchingDecoder(BatchingDecoder):
 
     def __init__(self, module, variables, *, page_tokens: Optional[int] = None,
                  pages: Optional[int] = None,
-                 prefix_cache: Optional[bool] = None, mesh=None, **kw):
+                 prefix_cache: Optional[bool] = None, mesh=None,
+                 spec: str = "", spec_k: Optional[int] = None,
+                 spec_adaptive: Optional[bool] = None,
+                 draft_module=None, draft_variables=None,
+                 spec_exit_layer: Optional[int] = None, **kw):
         if mesh is not None:
             raise ValueError(
                 "paged serving does not run on a mesh yet; use the dense "
@@ -1589,10 +1608,79 @@ class PagedBatchingDecoder(BatchingDecoder):
         use_trie = bool(prefix_cache if prefix_cache is not None
                         else cfg.serving_prefix_cache)
         self._pool = KVPool(npages, pt, prefix_cache=use_trie)
+        # --- speculative decoding (KUBEML_SERVING_SPEC=draft|self|off) ---
+        if spec in ("off", None):
+            spec = ""
+        if spec not in ("", "draft", "self"):
+            raise ValueError(f"unknown spec mode {spec!r} "
+                             f"(valid: 'off', 'draft', 'self')")
+        self.spec = spec
+        k_cap = int(spec_k if spec_k is not None else cfg.spec_k)
+        self.spec_exit_layer = 0
+        self.draft_module = None
+        self._draft_variables = None
+        self._draft_cache = None
+        if spec == "draft":
+            if draft_module is None or draft_variables is None:
+                raise GenerationInputError(
+                    "spec='draft' needs a draft module + variables "
+                    "(KUBEML_SPEC_DRAFT_MODEL names the checkpointed job)")
+            if not supports_paged_decode(draft_module):
+                raise GenerationInputError(
+                    "draft module has no paged decode path")
+            if getattr(draft_module, "vocab_size", None) != \
+                    getattr(module, "vocab_size", None):
+                raise GenerationInputError(
+                    "draft and target models must share one vocabulary")
+            if int(getattr(draft_module, "max_len", cap)) < int(cap):
+                raise GenerationInputError(
+                    f"draft model max_len "
+                    f"({getattr(draft_module, 'max_len', None)}) must cover "
+                    f"the target's ({cap})")
+            # the drafter addresses THE SAME page ids through its own
+            # arena, so shared-prefix pages carry valid draft K/V too
+            self.draft_module = draft_module.clone(page_tokens=pt,
+                                                   kv_pages=npages)
+        elif spec == "self":
+            depth = getattr(module, "depth", None)
+            e = int(spec_exit_layer if spec_exit_layer
+                    else max(1, (depth or 2) // 2))
+            if depth is not None and not (1 <= e <= depth):
+                raise GenerationInputError(
+                    f"spec_exit_layer must be in [1, depth={depth}], got {e}")
+            self.spec_exit_layer = e
+        from .spec import AdaptiveK
+
+        # the draft backend never suspends (its KV cache is only coherent
+        # while the drafter sees every decoded token); self-drafting may
+        # retreat to plain decode and re-probe
+        self._spec_ctl = (AdaptiveK(
+            k_cap,
+            adaptive=bool(spec_adaptive if spec_adaptive is not None
+                          else cfg.spec_adaptive),
+            allow_off=(spec == "self")) if spec else None)
+        # worst-case page reservation must cover the verify lookahead: a
+        # spec step writes up to k positions past the row's final token
+        # before the host learns they were rejected (admission math below)
+        self._spec_lookahead = k_cap if spec else 0
         # the arena dims ride the module as clone fields so the flax cache
         # variables know their shapes (params are untouched by the clone)
         module = module.clone(page_tokens=pt, kv_pages=npages)
         super().__init__(module, variables, mesh=None, **kw)
+        if spec == "draft":
+            from .quant import is_quantized_tree, quantize_tree
+
+            # the drafter rides the SAME int8 path as the target: a
+            # pre-quantized tree (the quantized-checkpoint store) loads
+            # as-is, a dense one quantizes here
+            if is_quantized_tree(draft_variables):
+                if self.quantize != "int8":
+                    raise ValueError(
+                        "draft variables carry int8 QuantizedTensor leaves "
+                        "but quantize is not 'int8'")
+            elif self.quantize == "int8":
+                draft_variables = quantize_tree(draft_variables)
+            self._draft_variables = jax.device_put(draft_variables)
         # pow2 chunk ladder: any remaining-step count decomposes into
         # ladder chunks, so chunks end EXACTLY at the earliest completion
         # (the per-token admission edge) with a bounded program set —
@@ -1611,6 +1699,23 @@ class PagedBatchingDecoder(BatchingDecoder):
                        donate_argnums=donate)
             for T in self._chunk_sizes
         }
+        if self.spec:
+            # one spec-step program per adaptive-k ladder rung (bounded
+            # compile set, like the chunk ladder); the slab and the draft
+            # cache are donated through the chain
+            spec_donate = () if jax.default_backend() == "cpu" else (1, 4)
+            self._spec_steps = {
+                kk: jax.jit(functools.partial(self._spec_step_impl, k=kk),
+                            donate_argnums=spec_donate)
+                for kk in self._spec_ctl.ladder
+            }
+            if self.spec == "draft":
+                # admission must also prefill the drafter's arena: swap in
+                # the draft-aware prefill program
+                self._prefill_admit = jax.jit(
+                    self._prefill_admit_spec_impl,
+                    donate_argnums=() if jax.default_backend() == "cpu"
+                    else (3, 2))
         # host page-table mirror handed to every dispatch ([slots, P] i32);
         # zeroed rows point at the trash page, so a retired/canceled row's
         # stale device writes can never reach a reallocated page
@@ -1619,9 +1724,13 @@ class PagedBatchingDecoder(BatchingDecoder):
     # --- capacity & programs ---
 
     def _check_capacity(self, plen: int, max_new: int) -> None:
-        if not self._pool.can_admit(plen, max_new):
+        if not self._pool.can_admit(plen, max_new,
+                                    lookahead=self._spec_lookahead,
+                                    max_positions=self.max_len):
+            need = self._pool.pages_for(self._pool.total_positions(
+                plen, max_new, self._spec_lookahead, self.max_len))
             raise KubeMLError(
-                f"request needs {self._pool.pages_for(plen + max_new - 1)} "
+                f"request needs {need} "
                 f"KV pages but the arena holds {self._pool.capacity} "
                 f"(KUBEML_SERVING_PAGES x KUBEML_SERVING_PAGE_TOKENS)", 400)
 
@@ -1631,6 +1740,21 @@ class PagedBatchingDecoder(BatchingDecoder):
         dense_abstract = jax.eval_shape(self._dense_vars, self._variables)
         return self._slab_from_cache(init_paged_cache(
             self.module, dense_abstract, self.slots, self.table_pages))
+
+    def _init_slab(self) -> _Slab:
+        slab = super()._init_slab()
+        if self.spec == "draft":
+            # the drafter's own paged arena (same page ids, its own
+            # head/depth dims) — rebuilt with the slab on fault recovery,
+            # so a zeroed target arena never pairs with stale draft K/V
+            from ..models.generation import init_paged_cache
+
+            dense_abstract = jax.eval_shape(self._dense_draft_vars,
+                                            self._draft_variables)
+            self._draft_cache = init_paged_cache(
+                self.draft_module, dense_abstract, self.slots,
+                self.table_pages)
+        return slab
 
     def _prefill_admit_impl(self, variables, slab, ptbl, suffix, base, slens,
                             rowids, max_news, temps, topks, eoss, keys):
@@ -1672,6 +1796,161 @@ class PagedBatchingDecoder(BatchingDecoder):
         packed = jnp.stack([firsts, live0.astype(jnp.int32)], axis=1)
         return slab2, packed
 
+    # --- speculative decoding (KUBEML_SERVING_SPEC=draft|self) ---
+
+    def _dense_draft_vars(self, dvars):
+        """The drafter's twin of ``_dense_vars``: int8 draft weights
+        densify inside the traced program (or flow natively in int8-matmul
+        mode); identity otherwise."""
+        if self.quantize != "int8" or self.int8_matmul:
+            return dvars
+        from .quant import dequantize_tree
+
+        return dequantize_tree(dvars, dtype=jnp.float32)
+
+    def _prefill_admit_spec_impl(self, variables, draft_variables,
+                                 draft_cache, slab, ptbl, suffix, base,
+                                 slens, rowids, max_news, temps, topks,
+                                 eoss, keys):
+        """Draft-backend admission: the target prefill+admit PLUS the
+        drafter's prefill of the same (unshared) suffix into its own
+        arena through the same page tables — a prefix hit skips both
+        prefills (the trie guarantees the cached pages were written from
+        identical prompt blocks, so the incumbent's draft K/V is equally
+        valid)."""
+        slab2, packed = self._prefill_admit_impl(
+            variables, slab, ptbl, suffix, base, slens, rowids, max_news,
+            temps, topks, eoss, keys)
+        dvars = self._dense_draft_vars(draft_variables)
+        _, dvs = self.draft_module.apply(
+            {**dvars, "cache": draft_cache}, suffix, decode=True,
+            positions=base, pages=ptbl, seq_lens=slens, mutable=["cache"])
+        return slab2, dvs["cache"], packed
+
+    def _spec_step_impl(self, variables, slab, pages, draft_variables,
+                        draft_cache, *, k):
+        """ONE speculative macro-step over every program row: the drafter
+        proposes k tokens per live row, the target verifies all k+1
+        positions in a single batched forward (the same L>1 paged suffix
+        path admission uses), and the canonical acceptance rule emits
+        1..k+1 tokens per row. Rollback is purely positional: a rejected
+        suffix's K/V entries are dead-by-position and the next step's
+        k+1-wide write window overwrites them — no copy, no page churn.
+
+        Emits a packed [k+1, S] block (-1 past each row's clip — host
+        routing is byte-compatible with the chunk path) plus a [2, S]
+        device-truth stats block (drafted, accepted per row)."""
+        variables = self._dense_vars(variables)
+        S = self.slots
+        from ..models.generation import (draft_sample, spec_accept,
+                                         spec_mask_emissions)
+
+        use, nxt_keys = _split_rows(slab.keys)
+        live = slab.live
+        if self.spec == "self":
+            dvars, dc0, dmod = variables, slab.cache, self.module
+            dkw = {"exit_layer": self.spec_exit_layer}
+        else:
+            dvars = self._dense_draft_vars(draft_variables)
+            dc0, dmod, dkw = draft_cache, self.draft_module, {}
+
+        def dr(carry, i):
+            dc, t, p = carry
+            lg, vs = dmod.apply(
+                {**dvars, "cache": dc}, t[:, None], decode=True,
+                positions=p, pages=pages,
+                seq_lens=jnp.where(live, 1, 0), mutable=["cache"], **dkw)
+            dk = jax.vmap(jax.random.fold_in)(use, jnp.full((S,), i))
+            d_i, q_i = draft_sample(lg[:, -1].astype(jnp.float32),
+                                    slab.temp, slab.topk, dk,
+                                    topk_cap=TOP_K_MAX)
+            return (vs["cache"], d_i, p + 1), (d_i, q_i)
+
+        # the draft backend runs one extra WRITE-ONLY iteration: the k-th
+        # draft's K/V must land in the drafter's own cache too, or a fully
+        # accepted step leaves a permanent zero-KV gap at that position
+        # (self-drafting skips it — the verify re-writes the shared arena)
+        iters = k + 1 if self.spec == "draft" else k
+        (dc_out, _, _), (d, q_probs) = jax.lax.scan(
+            dr, (dc0, slab.tok, slab.pos), jnp.arange(iters))
+        drafts = d.T[:, :k]                            # [S, k]
+        q_probs = jnp.moveaxis(q_probs, 0, 1)[:, :k]   # [S, k, V]
+        vcache = dc_out if self.spec == "self" else slab.cache
+        vt = jnp.concatenate([slab.tok[:, None], drafts], axis=1)
+        vlg, vs = self.module.apply(
+            {**variables, "cache": vcache}, vt, decode=True,
+            positions=slab.pos, pages=pages,
+            seq_lens=jnp.where(live, k + 1, 0), mutable=["cache"])
+        emit, n_acc = spec_accept(vlg.astype(jnp.float32), drafts, q_probs,
+                                  slab.temp, slab.topk, use,
+                                  topk_cap=TOP_K_MAX)
+        out, n_take, live2, rem2, feed = spec_mask_emissions(
+            emit, n_acc, live, slab.remaining, slab.eos, slab.tok)
+        pos2 = jnp.where(live, slab.pos + n_take, slab.pos)
+        slab2 = _Slab(vs["cache"], feed, pos2, live2, rem2, nxt_keys,
+                      slab.temp, slab.topk, slab.eos)
+        stats = jnp.stack([jnp.where(live, k, 0),
+                           jnp.where(live, n_acc, 0)]).astype(jnp.int32)
+        dc_ret = dc_out if self.spec == "draft" else None
+        return slab2, dc_ret, out.T, stats
+
+    def _dispatch_spec_chunk(self, k: int) -> tuple:
+        # the table ships as a copy for the same aliasing reason as
+        # _dispatch_chunk_paged
+        self._slab, dc, packed, stats = self._spec_steps[k](
+            self._variables, self._slab, jnp.asarray(self._table.copy()),
+            self._draft_variables, self._draft_cache)
+        if self.spec == "draft":
+            self._draft_cache = dc
+        for row in self._slot_rows:
+            if row is not None and not row.done and not row.canceled:
+                # a live row emits AT LEAST one token per macro-step, so
+                # counting 1 keeps the dispatch gate conservative (the
+                # actual count lands with the results)
+                row.dispatched += 1
+        self.stats.chunk()
+        return ("spec", packed, stats, list(self._slot_rows), k)
+
+    def _materialize(self, rec: tuple) -> tuple:
+        if rec[0] == "spec":
+            return ("spec", np.asarray(rec[1]), np.asarray(rec[2]),
+                    rec[3], rec[4])
+        return super()._materialize(rec)
+
+    def _process_record(self, rec: tuple) -> None:
+        if rec[0] != "spec":
+            return super()._process_record(rec)
+        _, packed, stats_arr, snapshot, k = rec
+        self._warmed = True
+        self.stats.chunk_fetched(0.0, 0)  # fetched by the pool already
+        emitted_mask = packed >= 0  # [k+1, S]
+        live_steps = int(emitted_mask.sum())
+        resident = [s for s, r in enumerate(snapshot) if r is not None]
+        dead = int((~emitted_mask[:, resident]).sum()) if resident else 0
+        T, S = packed.shape
+        # token-truth occupancy: ONE device step whose capacity is the
+        # verify window's S x (k+1) token slots. live = emitted, dead =
+        # a resident row's unemitted slots (rejected speculation — the
+        # measured cost of a wrong drafter), idle = no resident row. The
+        # partition identity live + dead + idle == steps x capacity holds,
+        # and tokens-per-step reads tokens_emitted / device_steps.
+        self.stats.chunk_occupancy(1, live_steps, dead,
+                                   T * S - live_steps - dead,
+                                   capacity=T * S)
+        drafted, accepted = stats_arr[0], stats_arr[1]
+        d_sum = int(drafted.sum())
+        a_sum = int(accepted.sum())
+        live_rows = int((drafted > 0).sum())
+        self.stats.spec_step(d_sum, a_sum, d_sum + live_rows)
+        if self._spec_ctl is not None:
+            self._spec_ctl.on_step(d_sum, a_sum)
+        for slot, row in enumerate(snapshot):
+            if row is None or drafted[slot] <= 0:
+                continue
+            row.spec_proposed += int(drafted[slot]) + 1
+            row.spec_accepted += int(accepted[slot])
+        self._route_chunk_tokens(packed, snapshot)
+
     # --- admission (engine thread; caller holds self._cond) ---
 
     def _take_admissions_locked(self, max_n: int) -> List[tuple]:
@@ -1688,7 +1967,9 @@ class PagedBatchingDecoder(BatchingDecoder):
             if row.canceled:
                 self._pending.popleft()
                 continue
-            lease = self._pool.admit(row.prompt, row.max_new)
+            lease = self._pool.admit(row.prompt, row.max_new,
+                                     lookahead=self._spec_lookahead,
+                                     max_positions=self.max_len)
             if lease is None:
                 break
             self._pending.popleft()
@@ -1740,11 +2021,17 @@ class PagedBatchingDecoder(BatchingDecoder):
             topks[i] = row.topk
             eoss[i] = row.eos
             keys[i] = row.key
-        self._slab, packed = self._prefill_admit(
-            self._variables, self._slab, jnp.asarray(ptbl),
-            jnp.asarray(suffix), jnp.asarray(base), jnp.asarray(slens),
-            jnp.asarray(rowids), jnp.asarray(max_news), jnp.asarray(temps),
-            jnp.asarray(topks), jnp.asarray(eoss), jnp.asarray(keys))
+        args = (jnp.asarray(ptbl), jnp.asarray(suffix), jnp.asarray(base),
+                jnp.asarray(slens), jnp.asarray(rowids),
+                jnp.asarray(max_news), jnp.asarray(temps),
+                jnp.asarray(topks), jnp.asarray(eoss), jnp.asarray(keys))
+        if self.spec == "draft":
+            self._slab, self._draft_cache, packed = self._prefill_admit(
+                self._variables, self._draft_variables, self._draft_cache,
+                self._slab, *args)
+        else:
+            self._slab, packed = self._prefill_admit(
+                self._variables, self._slab, *args)
         now = time.monotonic()
         real_tokens = 0
         for slot, row in group:
@@ -1850,6 +2137,12 @@ class PagedBatchingDecoder(BatchingDecoder):
     def telemetry(self) -> dict:
         snap = super().telemetry()
         snap.update(self._pool.telemetry())
+        if self._spec_ctl is not None:
+            # current adaptive speculation depth (0 = retreated to plain
+            # decode) + the controller's EWMA acceptance estimate
+            snap["spec_k"] = float(self._spec_ctl.current())
+            if self._spec_ctl.ratio >= 0:
+                snap["spec_accept_ewma"] = float(self._spec_ctl.ratio)
         return snap
 
     # --- the engine loop (paged flavor) ---
@@ -1900,7 +2193,20 @@ class PagedBatchingDecoder(BatchingDecoder):
                 self._retire_dispatched()
                 if (next_seq - process_seq < self.pipeline_depth
                         and (size := self._paged_chunk_size()) > 0):
-                    pool.submit(next_seq, self._dispatch_chunk_paged(size))
+                    # spec mode verifies k drafts per dispatch instead of
+                    # stepping one token; the adaptive controller may have
+                    # retreated (current() == 0), in which case plain
+                    # chunks run and count toward the re-probe
+                    spec_k_now = (self._spec_ctl.current()
+                                  if self._spec_ctl is not None else 0)
+                    if spec_k_now > 0:
+                        pool.submit(next_seq,
+                                    self._dispatch_spec_chunk(spec_k_now))
+                    else:
+                        pool.submit(next_seq,
+                                    self._dispatch_chunk_paged(size))
+                        if self._spec_ctl is not None:
+                            self._spec_ctl.on_plain_chunk()
                     next_seq += 1
                     dispatched = True
                     # the chunk may have fully dispatched rows: free their

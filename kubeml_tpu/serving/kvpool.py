@@ -255,19 +255,45 @@ class KVPool:
 
     # --- the admission interface (engine thread) ---
 
-    def can_admit(self, prompt_len: int, max_new: int) -> bool:
-        """Whether a row could EVER be admitted (fits the arena outright,
-        ignoring current occupancy) — the submit-time 400 guard."""
-        return self.pages_for(prompt_len + max_new - 1) <= self.capacity
+    def total_positions(self, prompt_len: int, max_new: int,
+                        lookahead: int = 0,
+                        max_positions: Optional[int] = None) -> int:
+        """Worst-case cache positions one row can WRITE: prompt +
+        ``max_new - 1`` decode writes (the last emitted token is returned,
+        never written) + ``lookahead`` speculative positions — a spec-mode
+        verify at depth k writes up to k positions past the row's final
+        token before the host learns they were rejected. ``max_positions``
+        (the model's ``max_len``) clamps the sum: the device trash-redirects
+        writes past the addressable range, so no page backs them."""
+        total = int(prompt_len) + int(max_new) - 1 + int(lookahead)
+        if max_positions is not None:
+            total = min(total, int(max_positions))
+        return total
 
-    def admit(self, prompt: Sequence[int],
-              max_new: int) -> Optional[PageLease]:
+    def can_admit(self, prompt_len: int, max_new: int, lookahead: int = 0,
+                  max_positions: Optional[int] = None) -> bool:
+        """Whether a row could EVER be admitted (fits the arena outright,
+        ignoring current occupancy) — the submit-time 400 guard. The
+        speculative ``lookahead`` rides the same worst-case math, so
+        enabling spec mode can never create a mid-flight OOM (and, clamped
+        at ``max_positions``, never 400s a request the plain engine
+        accepts: the worst case stays ``pages_for(max_len)``)."""
+        return self.pages_for(self.total_positions(
+            prompt_len, max_new, lookahead, max_positions)) <= self.capacity
+
+    def admit(self, prompt: Sequence[int], max_new: int,
+              lookahead: int = 0,
+              max_positions: Optional[int] = None) -> Optional[PageLease]:
         """Reserve one row's full worst-case page table: shared prefix
-        pages (refcount bumped) + fresh pages for the unshared suffix and
-        every decode position. None (nothing changed) when the pool can't
-        cover it — the row stays queued for the next chunk edge."""
+        pages (refcount bumped) + fresh pages for the unshared suffix,
+        every decode position, AND the speculative ``lookahead`` window
+        (reserved up front and held for the row's whole life — the
+        adaptive controller may shrink k mid-flight, but reservations are
+        invariant so rollback can never OOM). None (nothing changed) when
+        the pool can't cover it — the row stays queued for the next chunk
+        edge."""
         plen = len(prompt)
-        total = plen + max_new - 1  # positions 0..total-1 get written
+        total = self.total_positions(plen, max_new, lookahead, max_positions)
         need = self.pages_for(total)
         shared: List[int] = []
         if self.trie is not None and plen > 1:

@@ -88,6 +88,17 @@ class DecoderStats:
         # prompt tokens those cached pages covered (prefill skipped them)
         self.prefix_hits = 0
         self.prefix_tokens_saved = 0
+        # speculative decoding (paged engine spec mode): drafted = tokens
+        # the drafter sampled, proposed = candidate emissions submitted to
+        # one-pass verification (drafts + the bonus position per live row),
+        # accepted = drafted tokens that survived the rejection rule.
+        # acceptance ratio = accepted / drafted; tokens-per-step reads
+        # tokens_emitted / device_steps (a spec step counts ONE device
+        # step — its k+1-wide token capacity rides chunk_occupancy)
+        self.spec_steps = 0
+        self.spec_drafted_tokens = 0
+        self.spec_proposed_tokens = 0
+        self.spec_accepted_tokens = 0
         # fetcher pool (results/SERVING_R5_NOTE.md: short-request workloads
         # are fetch-pipeline-bound on tunneled hosts): completed fetches,
         # cumulative blocked wall seconds (rate/pool = utilization), live
@@ -124,6 +135,8 @@ class DecoderStats:
         self._hist_slot_idle = Histogram()
         # per-chunk live-fraction distribution (0..1 edges)
         self._hist_occupancy = Histogram(OCCUPANCY_BUCKETS)
+        # per-verify-step acceptance-ratio distribution (0..1 edges)
+        self._hist_spec_accept = Histogram(OCCUPANCY_BUCKETS)
         # live gauges are read from the decoder at render time (queue depth,
         # busy slots) — they belong to the engine's own state, not counters
 
@@ -162,6 +175,22 @@ class DecoderStats:
             self.dead_slot_steps += int(dead)
             self.idle_slot_steps += int(idle)
             self._hist_occupancy.observe(live / total if total else 0.0)
+
+    def spec_step(self, drafted: int, accepted: int, proposed: int) -> None:
+        """One processed speculative verify step: ``drafted`` tokens were
+        sampled by the drafter across the step's live rows, ``accepted``
+        of them passed the acceptance rule, ``proposed`` candidate
+        emissions went through the one-pass verification (drafts + the
+        bonus position per live row)."""
+        if drafted <= 0:
+            return
+        with self._lock:
+            self.spec_steps += 1
+            self.spec_drafted_tokens += int(drafted)
+            self.spec_accepted_tokens += int(accepted)
+            self.spec_proposed_tokens += int(proposed)
+            self._hist_spec_accept.observe(
+                min(1.0, int(accepted) / int(drafted)))
 
     def prefix_hit(self, tokens_saved: int) -> None:
         """One admission served partly from the shared-prefix cache:
@@ -327,6 +356,17 @@ class DecoderStats:
                     self.fetchers_inflight / self.fetchers_total
                     if self.fetchers_total else 0.0),
             }
+            # speculative-decoding series only exist once a spec step ran:
+            # dense decoders / spec-off engines keep a clean exposition
+            # (absence reads as "not speculating", like the paged gauges)
+            if self.spec_steps:
+                out["spec_steps"] = float(self.spec_steps)
+                out["spec_drafted_tokens"] = float(self.spec_drafted_tokens)
+                out["spec_proposed_tokens"] = float(self.spec_proposed_tokens)
+                out["spec_accepted_tokens"] = float(self.spec_accepted_tokens)
+                out["spec_accept_rate"] = (
+                    self.spec_accepted_tokens / self.spec_drafted_tokens
+                    if self.spec_drafted_tokens else 0.0)
             hist = {}
             for key, h in (("first_token", self._hist_first),
                            ("request", self._hist_request),
@@ -335,7 +375,8 @@ class DecoderStats:
                            ("prefill", self._hist_prefill),
                            ("decode_active", self._hist_decode_active),
                            ("slot_idle", self._hist_slot_idle),
-                           ("occupancy_ratio", self._hist_occupancy)):
+                           ("occupancy_ratio", self._hist_occupancy),
+                           ("spec_accept_ratio", self._hist_spec_accept)):
                 if h.count:
                     hist[key] = h.snapshot()
         if hist:

@@ -15,8 +15,11 @@ more, the LAST file is the candidate and the second-to-last the baseline (the
 trajectory context.
 
 Gate metrics (kubeml_tpu.benchmarks.harness.GATE_METRICS): device throughput,
-end-to-end throughput, and MFU — a candidate more than ``--threshold``
-(default 10%) below the baseline on ANY of them exits non-zero, which is how
+end-to-end throughput, MFU, the serving fraction, the spec-decode
+tokens/step + acceptance ratio, and serving latency — each carries its own
+DIRECTION metadata (throughputs/ratios are higher-is-better, latencies
+lower-is-better), and a candidate more than ``--threshold`` (default 10%)
+WORSE than the baseline on ANY of them exits non-zero, which is how
 CI/tier-1 consumes this (tests/test_bench_compare.py). A metric missing on
 either side (e.g. MFU on unknown hardware) is skipped with a note, never
 failed; a candidate carrying an ``error`` row fails outright. Improvements
@@ -56,7 +59,7 @@ def compare(baseline: dict, candidate: dict, threshold: float) -> dict:
         regressions.append({
             "metric": "error",
             "detail": f"candidate is an error row: {candidate['error']}"})
-    for key in GATE_METRICS:
+    for key, (_field, direction) in GATE_METRICS.items():
         base, cand = baseline.get(key), candidate.get(key)
         if base is None or cand is None or base <= 0:
             skipped.append({"metric": key, "baseline": base,
@@ -64,14 +67,18 @@ def compare(baseline: dict, candidate: dict, threshold: float) -> dict:
                             "reason": "missing or non-positive on one side"})
             continue
         delta = (cand - base) / base
+        # direction-aware: "higher" metrics regress when they DROP past the
+        # threshold, "lower" metrics (latencies) when they RISE past it
+        worse = -delta if direction == "higher" else delta
         check = {"metric": key, "baseline": base, "candidate": cand,
-                 "delta": round(delta, 4)}
+                 "delta": round(delta, 4), "direction": direction}
         checks.append(check)
-        if delta < -threshold:
+        if worse > threshold:
             regressions.append({
                 "metric": key,
-                "detail": f"{key} regressed {-delta:.1%} "
-                          f"({base:g} -> {cand:g}; threshold {threshold:.0%})"
+                "detail": f"{key} regressed {worse:.1%} "
+                          f"({base:g} -> {cand:g}; threshold {threshold:.0%};"
+                          f" {direction}-is-better)"
             })
     return {
         "baseline_file": baseline.get("file"),

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Speculative-decoding proof (CPU-measurable, no chip needed): drive one
+# mixed-length workload through the paged serving engine with speculation
+# off / a separate draft model / early-exit self-drafting, at batch 1 and
+# 8, greedy and sampled, appending the rows to results/spec_decode.jsonl.
+#
+#   scripts/spec_decode_demo.sh [--seed N] [--requests N] [--spec-k N]
+#                               [--max-new N] [--page-tokens N]
+#
+# The gate (ISSUE 14 acceptance) requires:
+#   a. greedy TOKEN PARITY vs the one-shot baseline in every mode,
+#      including the int8 compose row;
+#   b. spec_tokens_per_step > 1.0 for self-drafting at batch 1 (each
+#      weight stream over HBM amortized across >1 emitted token);
+#   c. the acceptance-rate counters live on a real PS /metrics HTTP
+#      scrape (KUBEML_SERVING_SPEC=self serving a finished checkpoint).
+# Exit status mirrors the gate. The spec_tokens_per_step /
+# spec_accept_ratio fields gate through scripts/bench_compare.py with
+# higher-is-better direction metadata.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p results
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m kubeml_tpu.benchmarks.spec_decode \
+    --out results/spec_decode.jsonl "$@"

@@ -85,6 +85,57 @@ def test_nothing_comparable_is_a_distinct_failure(tmp_path):
     assert p.returncode == 2
 
 
+def test_direction_metadata_lower_is_better_latency(tmp_path):
+    """Per-metric direction (ISSUE 14 satellite): a latency RISE past the
+    threshold regresses, a latency DROP passes — the opposite of the
+    throughput semantics the gate used to assume for everything."""
+    base = {"metric": "serving", "value": 100.0, "latency_p95_ms": 200.0}
+    worse = {**base, "latency_p95_ms": 300.0}   # +50% latency
+    better = {**base, "latency_p95_ms": 100.0}  # -50% latency
+    files = {}
+    for name, row in (("base", base), ("worse", worse), ("better", better)):
+        f = tmp_path / f"{name}.json"
+        f.write_text(json.dumps(row))
+        files[name] = f
+    p = _run(files["base"], files["worse"])
+    assert p.returncode == 1
+    report = json.loads(p.stdout)
+    assert report["regressions"][0]["metric"] == "serving_latency_p95_ms"
+    assert "lower-is-better" in report["regressions"][0]["detail"]
+    assert _run(files["base"], files["better"]).returncode == 0
+
+
+def test_spec_decode_rows_gate_tokens_per_step_and_acceptance(tmp_path):
+    """A drafter regression (fewer tokens/step, worse acceptance) fails
+    the gate through the same direction-aware code path as the serving
+    fraction."""
+    base = {"metric": "spec-decode-serving", "value": 1000.0,
+            "spec_tokens_per_step": 2.6, "spec_accept_ratio": 0.9}
+    bad = {**base, "spec_tokens_per_step": 1.1, "spec_accept_ratio": 0.2}
+    good = {**base, "spec_tokens_per_step": 2.8, "spec_accept_ratio": 0.95}
+    files = {}
+    for name, row in (("base", base), ("bad", bad), ("good", good)):
+        f = tmp_path / f"{name}.json"
+        f.write_text(json.dumps(row))
+        files[name] = f
+    p = _run(files["base"], files["bad"])
+    assert p.returncode == 1
+    report = json.loads(p.stdout)
+    regressed = {r["metric"] for r in report["regressions"]}
+    assert {"spec_tokens_per_step", "spec_accept_ratio"} <= regressed
+    assert _run(files["base"], files["good"]).returncode == 0
+
+
+def test_metric_direction_table():
+    from kubeml_tpu.benchmarks.harness import GATE_METRICS, metric_direction
+
+    assert metric_direction("spec_tokens_per_step") == "higher"
+    assert metric_direction("spec_accept_ratio") == "higher"
+    assert metric_direction("serving_latency_p95_ms") == "lower"
+    assert all(d in ("higher", "lower")
+               for _f, d in GATE_METRICS.values())
+
+
 def test_normalize_bench_row_handles_both_forms():
     from kubeml_tpu.benchmarks.harness import normalize_bench_row
 

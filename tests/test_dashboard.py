@@ -62,6 +62,7 @@ def _exported_names() -> set:
     stats.chunk_fetched(0.08, 8)
     stats.chunk_occupancy(8, 20, 6, 6)
     stats.admit_tokens(10, 22)
+    stats.spec_step(drafted=8, accepted=6, proposed=10)
     stats.fetch_started()
     stats.fetch_finished(0.01)
     stats.fetchers_total = 4
@@ -70,7 +71,7 @@ def _exported_names() -> set:
     snap = stats.snapshot()
     snap.update({"queue_depth": 1.0, "slots_busy": 1.0, "slots_total": 4.0,
                  "slot_occupancy": 0.25, "weight_bytes": 1024.0,
-                 "queue_limit": 16.0})
+                 "queue_limit": 16.0, "spec_k": 4.0})
     reg.set_serving_source(lambda: {"drift-model": snap})
     # SLO burn/state gauges
     reg.set_slo_source(lambda: {"burn": {("drift", "fast"): 0.5},
@@ -148,6 +149,17 @@ def test_elastic_observability_panels_present():
                    "kubeml_job_worker_divergence_bucket",
                    "kubeml_job_loss_spread_bucket",
                    "kubeml_job_round_skew_ratio_bucket"):
+        assert metric in refs, f"no panel charts {metric}"
+
+
+def test_spec_decode_panels_present():
+    """The ISSUE-14 acceptance panel: drafted/accepted rates, the per-step
+    acceptance-ratio histogram, and the adaptive-k gauge must be charted."""
+    refs = _dashboard_names()
+    for metric in ("kubeml_serving_spec_accepted_tokens_total",
+                   "kubeml_serving_spec_drafted_tokens_total",
+                   "kubeml_serving_spec_accept_ratio_bucket",
+                   "kubeml_serving_spec_k"):
         assert metric in refs, f"no panel charts {metric}"
 
 

@@ -182,6 +182,9 @@ def test_dashboard_metrics_exist_in_registry():
     stats.chunk_occupancy(8, live=10, dead=2, idle=4)
     stats.admit_tokens(real=6, padding=10)
     stats.emitted(4)
+    # one speculative verify step so the acceptance-ratio histogram's
+    # _bucket series renders (the spec acceptance panel queries it)
+    stats.spec_step(drafted=8, accepted=6, proposed=10)
     reg.set_serving_source(lambda: {"m": stats.snapshot()})
     # SLO burn/state gauges (the burn-rate and alert-state panels)
     reg.set_slo_source(lambda: {"burn": {("o", "fast"): 0.5},

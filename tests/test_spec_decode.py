@@ -1,0 +1,708 @@
+"""Speculative decoding (ISSUE 14): distribution-preserving acceptance,
+greedy token parity on the paged engine (incl. the int8 compose), paged
+rollback exactness, lookahead page reservation, the adaptive-k controller,
+and the jit-cache-key regression.
+
+Correctness bars:
+
+* GREEDY PARITY — spec decode (both drafter backends, any drafter
+  quality) must emit BIT-IDENTICAL tokens to the baseline across
+  mixed-length paged workloads: acceptance tests the draft against the
+  target argmax and the correction IS the target argmax, so the emitted
+  chain is the baseline chain by construction.
+* DISTRIBUTION PRESERVATION — sampled acceptance (accept min(1, p/q),
+  resample the normalized residual) leaves the emitted marginal exactly
+  the target distribution; chi-square holds both at the acceptance-math
+  unit level and end-to-end against the no-spec sampler over a seed
+  chain.
+* ROLLBACK EXACTNESS — rejection rewinds by POSITION (no copy, no page
+  churn): after spec traffic incl. cancels/timeouts the pool's
+  ``check()`` balances exactly and goodput + wasted == emitted stays an
+  exact partition.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubeml_tpu.api.errors import KubeMLError
+from kubeml_tpu.api.types import GenerateRequest
+from kubeml_tpu.models.generation import (
+    _knob_probs, draft_sample, generate, make_speculative_generate_fn,
+    spec_accept, spec_mask_emissions)
+from kubeml_tpu.models.gpt import CausalTransformer
+from kubeml_tpu.serving.batcher import PagedBatchingDecoder
+from kubeml_tpu.serving.kvpool import KVPool
+from kubeml_tpu.serving.spec import AdaptiveK
+
+VOCAB = 101
+
+
+def tiny(pos="learned", max_len=64):
+    return CausalTransformer(vocab_size=VOCAB, max_len=max_len, embed_dim=64,
+                             depth=2, num_heads=4, pos=pos)
+
+
+@pytest.fixture(scope="module", params=["learned", "rope"])
+def served(request):
+    m = tiny(request.param)
+    variables = m.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    return m, variables
+
+
+def one_shot(m, variables, prompt, n, **kw):
+    out = generate(m, variables, np.asarray(prompt, np.int32),
+                   max_new_tokens=n, **kw)
+    return np.asarray(out.tokens), np.asarray(out.lengths)
+
+
+# --- acceptance math (no engine, no device loops) ---
+
+
+def test_spec_accept_greedy_prefix_rule():
+    """Greedy acceptance is the leading-argmax-match run, and the
+    correction is the target argmax at the first mismatch."""
+    S, k, V = 3, 3, 7
+    logits = np.full((S, k + 1, V), -10.0, np.float32)
+    # target argmax chain per row: [2, 3, 4, 5]
+    for i in range(k + 1):
+        logits[:, i, 2 + i] = 5.0
+    drafts = np.array([[2, 3, 4],  # all match -> n_acc 3, bonus argmax 5
+                       [2, 6, 4],  # mismatch at 1 -> n_acc 1, corr argmax 3
+                       [0, 3, 4]],  # mismatch at 0 -> n_acc 0, corr argmax 2
+                      np.int32)
+    q = np.full((S, k, V), 1.0 / V, np.float32)
+    temp = np.zeros((S,), np.float32)
+    topk = np.zeros((S,), np.int32)
+    keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.PRNGKey(0), i))(
+        np.arange(S))
+    emit, n_acc = spec_accept(jax.numpy.asarray(logits),
+                              jax.numpy.asarray(drafts),
+                              jax.numpy.asarray(q),
+                              jax.numpy.asarray(temp),
+                              jax.numpy.asarray(topk), keys)
+    assert np.asarray(n_acc).tolist() == [3, 1, 0]
+    assert np.asarray(emit).tolist() == [[2, 3, 4, 5],
+                                         [2, 3, -1, -1],
+                                         [2, -1, -1, -1]]
+
+
+def test_spec_accept_identical_p_q_always_accepts():
+    """p == q means min(1, p/q) == 1 everywhere: every draft the drafter
+    actually sampled from q is accepted (u*q < p holds for u < 1)."""
+    S, k, V = 512, 4, 7
+    rng = np.random.default_rng(0)
+    base = rng.normal(size=(V,)).astype(np.float32)
+    logits = np.tile(base, (S, k + 1, 1))
+    temp = np.full((S,), 1.0, np.float32)
+    topk = np.zeros((S,), np.int32)
+    keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.PRNGKey(1), i))(
+        np.arange(S))
+    # drafts drawn FROM q (the same knob distribution) position by position
+    drafts = np.zeros((S, k), np.int32)
+    qp = np.zeros((S, k, V), np.float32)
+    for i in range(k):
+        dk = jax.vmap(lambda kk: jax.random.fold_in(kk, i))(keys)
+        d_i, q_i = draft_sample(jax.numpy.asarray(logits[:, i]),
+                                jax.numpy.asarray(temp),
+                                jax.numpy.asarray(topk), dk)
+        drafts[:, i] = np.asarray(d_i)
+        qp[:, i] = np.asarray(q_i)
+    _, n_acc = spec_accept(jax.numpy.asarray(logits),
+                           jax.numpy.asarray(drafts),
+                           jax.numpy.asarray(qp),
+                           jax.numpy.asarray(temp),
+                           jax.numpy.asarray(topk), keys)
+    assert np.asarray(n_acc).tolist() == [k] * S
+
+
+@pytest.mark.spec
+def test_spec_accept_marginal_is_target_distribution():
+    """The core Leviathan invariant, tested as a math unit with high
+    power: drafts from a WRONG q, accepted/corrected by the rule, leave
+    the first emitted token distributed exactly as p. Chi-square over
+    many iid rows against the analytic p."""
+    S, V = 6000, 7
+    rng = np.random.default_rng(2)
+    tgt = np.tile(rng.normal(size=(V,)).astype(np.float32) * 1.5,
+                  (S, 2, 1))  # k = 1
+    draft_logits = np.tile(rng.normal(size=(V,)).astype(np.float32) * 1.5,
+                           (S, 1))
+    temp = np.full((S,), 1.0, np.float32)
+    topk = np.zeros((S,), np.int32)
+    keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.PRNGKey(3), i))(
+        np.arange(S))
+    dk = jax.vmap(lambda kk: jax.random.fold_in(kk, 0))(keys)
+    drafts, qp = draft_sample(jax.numpy.asarray(draft_logits),
+                              jax.numpy.asarray(temp),
+                              jax.numpy.asarray(topk), dk)
+    emit, _ = spec_accept(jax.numpy.asarray(tgt),
+                          np.asarray(drafts)[:, None],
+                          np.asarray(qp)[:, None, :],
+                          jax.numpy.asarray(temp),
+                          jax.numpy.asarray(topk), keys)
+    first = np.asarray(emit)[:, 0]
+    p = np.asarray(_knob_probs(jax.numpy.asarray(tgt[:, 0]),
+                               jax.numpy.asarray(temp),
+                               jax.numpy.asarray(topk)))[0]
+    obs = np.bincount(first, minlength=V).astype(np.float64)
+    exp = p.astype(np.float64) * S
+    chi2 = float(((obs - exp) ** 2 / np.maximum(exp, 1e-9)).sum())
+    # df = V - 1 = 6; p=0.001 critical value 22.46 — generous but real
+    assert chi2 < 22.46, (chi2, obs.tolist(), exp.tolist())
+
+
+def test_spec_mask_emissions_clips_remaining_and_eos():
+    emit = np.array([[5, 6, 7, 8],
+                     [5, 9, 7, 8],
+                     [5, 6, 7, 8]], np.int32)
+    n_acc = np.array([3, 3, 3], np.int32)
+    live = np.array([True, True, False])
+    rem = np.array([2, 4, 4], np.int32)
+    eos = np.array([-1, 9, -1], np.int32)
+    tok = np.array([1, 1, 1], np.int32)
+    out, n_take, live2, rem2, feed = (
+        np.asarray(v) for v in spec_mask_emissions(
+            jax.numpy.asarray(emit), jax.numpy.asarray(n_acc),
+            jax.numpy.asarray(live), jax.numpy.asarray(rem),
+            jax.numpy.asarray(eos), jax.numpy.asarray(tok)))
+    # row 0: remaining 2 clips to two emissions; row 1: eos 9 at index 1
+    # clips AFTER the eos; row 2: dead row emits nothing, feed frozen
+    assert out.tolist() == [[5, 6, -1, -1], [5, 9, -1, -1],
+                            [-1, -1, -1, -1]]
+    assert n_take.tolist() == [2, 2, 0]
+    assert live2.tolist() == [False, False, False]  # rem hit 0 / eos / dead
+    assert feed.tolist() == [6, 9, 1]
+
+
+# --- one-shot parity + distribution preservation end to end ---
+
+
+@pytest.mark.spec
+def test_one_shot_spec_greedy_parity_both_backends(served):
+    m, variables = served
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, VOCAB, size=(2, 7)).astype(np.int32)
+    ref, ref_len = one_shot(m, variables, prompt, 12)
+    dm = CausalTransformer(vocab_size=VOCAB, max_len=64, embed_dim=32,
+                           depth=1, num_heads=4)
+    dvs = dm.init(jax.random.PRNGKey(5), np.zeros((1, 8), np.int32))
+    for kw in (dict(spec="self", exit_layer=1),
+               dict(spec="self", exit_layer=2),
+               dict(spec="draft", draft_module=dm)):
+        fn = make_speculative_generate_fn(m, max_new_tokens=12, spec_k=3,
+                                          page_tokens=4, **kw)
+        out = fn(variables, prompt,
+                 draft_variables=dvs if kw["spec"] == "draft" else None)
+        assert np.array_equal(np.asarray(out.tokens), ref), kw
+        assert np.array_equal(np.asarray(out.lengths), ref_len)
+        assert out.proposed >= out.drafted >= out.accepted >= 0
+        assert out.steps <= 12
+
+
+@pytest.mark.spec
+def test_one_shot_spec_eos_parity(served):
+    m, variables = served
+    prompt = np.arange(2, 10, dtype=np.int32)[None]
+    ref, _ = one_shot(m, variables, prompt, 10)
+    eos = int(ref[0, 3])
+    ref_e, ref_len = one_shot(m, variables, prompt, 10, eos_id=eos)
+    fn = make_speculative_generate_fn(m, max_new_tokens=10, spec="self",
+                                      spec_k=3, exit_layer=2, eos_id=eos,
+                                      page_tokens=4)
+    out = fn(variables, prompt)
+    assert np.array_equal(np.asarray(out.tokens), ref_e)
+    assert np.array_equal(np.asarray(out.lengths), ref_len)
+
+
+@pytest.mark.spec
+@pytest.mark.slow
+def test_sampled_spec_preserves_distribution_vs_no_spec_sampler():
+    """End-to-end distribution preservation on a tiny vocab: the FIRST
+    spec-influenced position's marginal, across a fixed seed chain, is
+    two-sample-chi-square-indistinguishable between the no-spec sampler
+    and sampled spec decode with a deliberately WRONG (weak) drafter."""
+    V = 11
+    m = CausalTransformer(vocab_size=V, max_len=16, embed_dim=16,
+                          depth=2, num_heads=2)
+    vs = m.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.int32))
+    prompt = np.array([[3, 5, 2, 7]], np.int32)
+    fn = make_speculative_generate_fn(
+        m, max_new_tokens=3, spec="self", spec_k=2, exit_layer=1,
+        temperature=1.0, page_tokens=4)
+    n = 400
+    spec_counts = np.zeros(V, np.int64)
+    base_counts = np.zeros(V, np.int64)
+    for seed in range(n):
+        rng = jax.random.PRNGKey(10_000 + seed)
+        base = generate(m, vs, prompt, max_new_tokens=3, temperature=1.0,
+                        rng=rng)
+        sp = fn(vs, prompt, rng=rng)
+        # position 0 (the prefill draw) shares one code path; position 1
+        # is the first acceptance-rule-produced token
+        base_counts[int(np.asarray(base.tokens)[0, 1])] += 1
+        spec_counts[int(np.asarray(sp.tokens)[0, 1])] += 1
+    tot = spec_counts + base_counts
+    mask = tot > 0
+    chi2 = float((((spec_counts - base_counts) ** 2)[mask]
+                  / tot[mask]).sum())
+    df = int(mask.sum()) - 1
+    # p=0.001 critical values for df<=10
+    crit = {1: 10.83, 2: 13.82, 3: 16.27, 4: 18.47, 5: 20.52, 6: 22.46,
+            7: 24.32, 8: 26.12, 9: 27.88, 10: 29.59}[max(1, min(df, 10))]
+    assert chi2 < crit, (chi2, df, spec_counts.tolist(),
+                         base_counts.tolist())
+
+
+# --- engine parity (the serving tentpole) ---
+
+
+@pytest.mark.spec
+def test_engine_spec_greedy_parity_mixed_lengths(served):
+    """Mixed prompt/generation lengths through few program rows, both
+    backends, weak and strong drafters — every row token-identical to the
+    one-shot baseline, allocator exact at drain."""
+    m, variables = served
+    rng = np.random.default_rng(0)
+    lens = [3, 9, 5, 12, 7, 4]
+    news = [6, 12, 3, 1, 9, 17]
+    prompts = [rng.integers(1, VOCAB, size=(1, l)).astype(np.int32)
+               for l in lens]
+    refs = [one_shot(m, variables, p, n)[0][0].tolist()
+            for p, n in zip(prompts, news)]
+    dm = CausalTransformer(vocab_size=VOCAB, max_len=64, embed_dim=32,
+                           depth=1, num_heads=4)
+    dvs = dm.init(jax.random.PRNGKey(5), np.zeros((1, 8), np.int32))
+    for kw in (dict(spec="self", spec_exit_layer=2),
+               dict(spec="self", spec_exit_layer=1),
+               dict(spec="draft", draft_module=dm, draft_variables=dvs)):
+        dec = PagedBatchingDecoder(m, variables, slots=3, chunk_steps=8,
+                                   page_tokens=4, spec_k=3,
+                                   spec_adaptive=False, **kw)
+        try:
+            entries = [dec.submit(GenerateRequest(prompts=p.tolist(),
+                                                  max_new_tokens=n))
+                       for p, n in zip(prompts, news)]
+            for e, ref in zip(entries, refs):
+                out = dec.wait(e, timeout=600)
+                assert out["tokens"][0] == ref, kw
+                assert out["spec_proposed_tokens"] >= \
+                    out["spec_accepted_tokens"] >= 0
+            t = dec.telemetry()
+            # token-truth accounting stays an exact partition
+            assert (t["live_slot_steps"] + t["dead_slot_steps"]
+                    + t["idle_slot_steps"]) == t["slot_steps"]
+            assert t["goodput_tokens"] + t["wasted_tokens"] \
+                == t["tokens_emitted"]
+            assert t["spec_steps"] > 0
+            chk = dec._pool.check()
+            assert chk["held"] == chk["trie_pages"]
+        finally:
+            dec.close()
+
+
+@pytest.mark.spec
+def test_engine_spec_int8_compose():
+    """The int8 point of the PR: target AND drafter run quantized weights;
+    spec int8 decode is token-identical to plain int8 paged decode."""
+    m = tiny()
+    variables = m.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    p = np.arange(1, 10, dtype=np.int32)[None]
+    req = dict(prompts=p.tolist(), max_new_tokens=8)
+    outs = []
+    for kw in (dict(),
+               dict(spec="self", spec_exit_layer=2, spec_k=3,
+                    spec_adaptive=False),
+               dict(spec="draft", draft_module=m, draft_variables=variables,
+                    spec_k=3, spec_adaptive=False)):
+        dec = PagedBatchingDecoder(m, variables, slots=2, chunk_steps=4,
+                                   page_tokens=4, quantize="int8", **kw)
+        try:
+            outs.append(dec.wait(dec.submit(GenerateRequest(**req)),
+                                 timeout=600))
+        finally:
+            dec.close()
+    assert outs[0]["tokens"] == outs[1]["tokens"] == outs[2]["tokens"]
+    from kubeml_tpu.serving.quant import is_quantized_tree
+
+    # the drafter really rode the int8 path
+    dec = PagedBatchingDecoder(m, variables, slots=2, chunk_steps=4,
+                               page_tokens=4, quantize="int8", spec="draft",
+                               draft_module=m, draft_variables=variables)
+    try:
+        assert is_quantized_tree(dec._draft_variables)
+    finally:
+        dec.close()
+
+
+@pytest.mark.spec
+def test_engine_sampled_spec_deterministic_and_eos(served):
+    m, variables = served
+    p = np.arange(1, 12, dtype=np.int32)[None]
+    dec = PagedBatchingDecoder(m, variables, slots=2, chunk_steps=4,
+                               page_tokens=4, spec="self", spec_exit_layer=2,
+                               spec_k=2, spec_adaptive=False)
+    try:
+        req = dict(prompts=p.tolist(), max_new_tokens=9, temperature=0.8,
+                   top_k=7, seed=42)
+        a = dec.wait(dec.submit(GenerateRequest(**req)), timeout=600)
+        b = dec.wait(dec.submit(GenerateRequest(**req)), timeout=600)
+        assert a["tokens"] == b["tokens"]
+        assert a["lengths"] == b["lengths"]
+        # eos parity vs one-shot baseline under greedy
+        ref, _ = one_shot(m, variables, p, 8)
+        eos = int(ref[0, 2])
+        ref_e, ref_len = one_shot(m, variables, p, 8, eos_id=eos)
+        out = dec.wait(dec.submit(GenerateRequest(
+            prompts=p.tolist(), max_new_tokens=8, eos_id=eos)), timeout=600)
+        assert out["tokens"][0] == ref_e[0].tolist()
+        assert out["lengths"] == [int(ref_len[0])]
+    finally:
+        dec.close()
+
+
+# --- rollback exactness under abandonment (satellite: tests) ---
+
+
+@pytest.mark.spec
+def test_spec_rollback_exactness_under_cancel_and_timeout(served):
+    """Spec traffic with waiters giving up mid-flight: pages balance
+    exactly at drain (no leak from speculative lookahead writes) and
+    goodput + wasted == emitted stays exact."""
+    import time
+
+    m, variables = served
+    dec = PagedBatchingDecoder(m, variables, slots=3, chunk_steps=8,
+                               page_tokens=4, pages=41, spec="self",
+                               spec_exit_layer=2, spec_k=3)
+    try:
+        rng = np.random.default_rng(7)
+        entries = []
+        for i in range(10):
+            prompt = rng.integers(1, VOCAB, size=(1, int(rng.integers(3, 14))))
+            entries.append(dec.submit(GenerateRequest(
+                prompts=prompt.astype(np.int32).tolist(),
+                max_new_tokens=int(rng.integers(4, 24)))))
+        for i, e in enumerate(entries):
+            if i % 3 == 0:
+                dec.cancel(e)
+            elif i % 3 == 1:
+                # the waiter gives up immediately; a fast request may have
+                # already completed — either outcome feeds the exactness
+                # check, which is what this storm is for
+                dec._warmed = True
+                try:
+                    dec.wait(e, timeout=0.0)
+                except KubeMLError:
+                    pass
+            else:
+                dec.wait(e, timeout=600)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with dec._cond:
+                idle = (not dec._pending and not dec._busy()
+                        and not dec._draining)
+            if idle:
+                break
+            time.sleep(0.05)
+        assert idle, "engine did not drain"
+        chk = dec._pool.check()  # raises on leak/double-free/overlap
+        assert chk["held"] == chk["trie_pages"]
+        dec._pool.trie.flush()
+        assert dec._pool.free_pages() == dec._pool.capacity
+        t = dec.telemetry()
+        assert t["goodput_tokens"] + t["wasted_tokens"] == t["tokens_emitted"]
+    finally:
+        dec.close()
+
+
+# --- lookahead page reservation (satellite: kvpool admission math) ---
+
+
+def test_pool_lookahead_reserves_spec_window():
+    pool = KVPool(17, 4, prefix_cache=False)  # 16 usable
+    # 8 + 7 = 15 positions -> 4 pages plain; +3 lookahead -> 18 -> 5 pages
+    a = pool.admit(np.arange(1, 9), 8, lookahead=3)
+    assert len(a.pages) == 5
+    pool.release(a)
+    # the clamp: max_positions caps the sum, so a request already at the
+    # model cap reserves exactly the plain worst case
+    b = pool.admit(np.arange(1, 9), 8, lookahead=3, max_positions=15)
+    assert len(b.pages) == 4
+    pool.release(b)
+    pool.check()
+
+
+def test_pool_can_admit_lookahead_clamped_never_regresses():
+    pool = KVPool(5, 4, prefix_cache=False)  # 4 usable = 16 positions
+    assert pool.can_admit(8, 9)  # 16 positions exactly
+    # unclamped lookahead would need 17 -> refused...
+    assert not pool.can_admit(8, 9, lookahead=4)
+    # ...but clamped at the model cap (the engine always passes max_len)
+    # the spec engine admits everything the plain engine admits
+    assert pool.can_admit(8, 9, lookahead=4, max_positions=16)
+
+
+# --- adaptive-k controller units ---
+
+
+def test_adaptive_k_walks_down_and_suspends():
+    ctl = AdaptiveK(4, cooldown=2, probe_every=3)
+    assert ctl.ladder == [1, 2, 4]
+    assert ctl.current() == 4
+    for _ in range(20):
+        ctl.on_step(drafted=8, accepted=0)
+    assert ctl.current() == 0  # walked 4 -> 2 -> 1 -> suspended
+    assert ctl.suspensions == 1
+    for _ in range(3):
+        ctl.on_plain_chunk()
+    assert ctl.current() == 1  # re-probe at the bottom rung
+
+
+def test_adaptive_k_grows_on_high_acceptance():
+    ctl = AdaptiveK(8, cooldown=2)
+    ctl._idx = 0  # start at k=1
+    for _ in range(20):
+        ctl.on_step(drafted=4, accepted=4)
+    assert ctl.current() == 8
+
+
+def test_adaptive_k_draft_mode_floors_at_one():
+    ctl = AdaptiveK(4, cooldown=1, allow_off=False)
+    for _ in range(50):
+        ctl.on_step(drafted=8, accepted=0)
+    assert ctl.current() == 1  # never suspends
+    assert ctl.suspensions == 0
+
+
+def test_adaptive_k_pinned_when_not_adaptive():
+    ctl = AdaptiveK(4, adaptive=False)
+    for _ in range(50):
+        ctl.on_step(drafted=8, accepted=0)
+    assert ctl.current() == 4
+
+
+# --- the jit-cache-key regression (satellite: small fix) ---
+
+
+def test_generate_cache_key_isolates_spec_configs():
+    """Toggling spec modes / k / drafters between generate() calls with
+    identical sampling knobs must never serve a stale compiled program."""
+    from kubeml_tpu.models import generation as G
+
+    m = tiny()
+    variables = m.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    dm1 = CausalTransformer(vocab_size=VOCAB, max_len=64, embed_dim=32,
+                            depth=1, num_heads=4)
+    dm2 = CausalTransformer(vocab_size=VOCAB, max_len=64, embed_dim=16,
+                            depth=1, num_heads=2)
+    dvs1 = dm1.init(jax.random.PRNGKey(1), np.zeros((1, 8), np.int32))
+    dvs2 = dm2.init(jax.random.PRNGKey(2), np.zeros((1, 8), np.int32))
+    prompt = np.arange(1, 8, dtype=np.int32)[None]
+    with G._GENERATE_CACHE_LOCK:
+        G._GENERATE_CACHE.clear()
+    ref = generate(m, variables, prompt, max_new_tokens=6)
+    outs = [
+        generate(m, variables, prompt, max_new_tokens=6,
+                 spec="self", spec_exit_layer=2),
+        generate(m, variables, prompt, max_new_tokens=6,
+                 spec="self", spec_exit_layer=2, spec_k=2),
+        generate(m, variables, prompt, max_new_tokens=6, spec="draft",
+                 draft_module=dm1, draft_variables=dvs1),
+        generate(m, variables, prompt, max_new_tokens=6, spec="draft",
+                 draft_module=dm2, draft_variables=dvs2),
+    ]
+    # every config keyed its own entry (same sampling knobs throughout)
+    with G._GENERATE_CACHE_LOCK:
+        assert len(G._GENERATE_CACHE) == 5
+    # and none of them served a stale program: greedy outputs all equal
+    # the baseline BY MATH, through five distinct compiled pipelines
+    for out in outs:
+        assert np.array_equal(np.asarray(out.tokens), np.asarray(ref.tokens))
+    # toggling back to spec-off hits the plain program again, not a spec fn
+    again = generate(m, variables, prompt, max_new_tokens=6)
+    assert np.array_equal(np.asarray(again.tokens), np.asarray(ref.tokens))
+    with G._GENERATE_CACHE_LOCK:
+        assert len(G._GENERATE_CACHE) == 5
+
+
+# --- validation surfaces ---
+
+
+def test_engine_rejects_bad_spec_configs(served):
+    m, variables = served
+    with pytest.raises(ValueError):
+        PagedBatchingDecoder(m, variables, slots=2, spec="banana")
+    with pytest.raises(Exception):
+        PagedBatchingDecoder(m, variables, slots=2, spec="draft")  # no model
+    wrong_vocab = CausalTransformer(vocab_size=7, max_len=64, embed_dim=32,
+                                    depth=1, num_heads=4)
+    wv = wrong_vocab.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.int32))
+    with pytest.raises(Exception):
+        PagedBatchingDecoder(m, variables, slots=2, spec="draft",
+                             draft_module=wrong_vocab, draft_variables=wv)
+    with pytest.raises(Exception):
+        PagedBatchingDecoder(m, variables, slots=2, spec="self",
+                             spec_exit_layer=99)
+
+
+def test_exit_layer_validation():
+    m = tiny()
+    vs = m.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.int32))
+    with pytest.raises(ValueError):
+        m.apply(vs, np.zeros((1, 2), np.int32), exit_layer=0)
+    with pytest.raises(ValueError):
+        m.apply(vs, np.zeros((1, 2), np.int32), exit_layer=3)
+
+
+# --- stats + exposition ---
+
+
+def test_stats_spec_counters_and_exposition():
+    from kubeml_tpu.ps.metrics import MetricsRegistry
+    from kubeml_tpu.serving.stats import DecoderStats
+
+    s = DecoderStats(slots=4)
+    assert "spec_steps" not in s.snapshot()  # absent until spec runs
+    s.spec_step(drafted=8, accepted=6, proposed=10)
+    s.spec_step(drafted=8, accepted=2, proposed=10)
+    snap = s.snapshot()
+    assert snap["spec_steps"] == 2.0
+    assert snap["spec_drafted_tokens"] == 16.0
+    assert snap["spec_proposed_tokens"] == 20.0
+    assert snap["spec_accepted_tokens"] == 8.0
+    assert snap["spec_accept_rate"] == 0.5
+    assert snap["hist"]["spec_accept_ratio"]["count"] == 2
+    snap["spec_k"] = 4.0
+    reg = MetricsRegistry()
+    reg.set_serving_source(lambda: {"m1": snap})
+    text = reg.render()
+    assert 'kubeml_serving_spec_drafted_tokens_total{model="m1"} 16.0' in text
+    assert 'kubeml_serving_spec_proposed_tokens_total{model="m1"} 20.0' in text
+    assert 'kubeml_serving_spec_accepted_tokens_total{model="m1"} 8.0' in text
+    assert 'kubeml_serving_spec_accept_rate{model="m1"} 0.5' in text
+    assert 'kubeml_serving_spec_k{model="m1"} 4.0' in text
+    assert 'kubeml_serving_spec_accept_ratio_bucket{model="m1"' in text
+
+
+@pytest.mark.spec
+def test_ps_degrades_to_plain_decode_on_bad_spec_config(tmp_path):
+    """A spec misconfiguration that only surfaces at decoder construction
+    (exit layer beyond the model's depth) must serve WITHOUT speculation,
+    not 500 every /generate."""
+    from kubeml_tpu.api.config import Config
+    from kubeml_tpu.functions.registry import FunctionRegistry
+    from kubeml_tpu.ps.parameter_server import ParameterServer
+    from kubeml_tpu.storage.checkpoint import FINAL_TAG, CheckpointStore
+
+    fn_src = """
+import optax
+from kubeml_tpu.runtime.model import KubeModel
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.models.gpt import CausalTransformer
+
+class Tokens(KubeDataset):
+    def __init__(self):
+        super().__init__("tokens")
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(Tokens())
+    def build(self):
+        return CausalTransformer(vocab_size=64, max_len=32, embed_dim=32,
+                                 depth=2, num_heads=4)
+    def configure_optimizers(self):
+        return optax.adamw(self.lr)
+"""
+    import flax.linen as nn
+
+    module = CausalTransformer(vocab_size=64, max_len=32, embed_dim=32,
+                               depth=2, num_heads=4)
+    variables = module.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.int32))
+    variables = jax.tree.map(np.asarray, nn.meta.unbox(variables))
+    cfg = Config(data_root=tmp_path, serving_slots=2, serving_chunk_steps=4,
+                 serving_page_tokens=4, serving_spec="self",
+                 spec_exit_layer=99)  # beyond depth: constructor rejects
+    cfg.ensure_dirs()
+    reg = FunctionRegistry(config=cfg)
+    reg.create("degfn", fn_src)
+    CheckpointStore(config=cfg).save(
+        "degjob", variables, epoch=1, tag=FINAL_TAG,
+        meta={"request": {"function_name": "degfn"}})
+    ps = ParameterServer(registry=reg, config=cfg)
+    out = ps.generate("degjob", GenerateRequest(prompts=[[1, 2, 3, 4]],
+                                                max_new_tokens=4))
+    assert len(out["tokens"][0]) == 4
+    assert out["spec_proposed_tokens"] == 0
+    dec = ps._decoders["degjob"][0]
+    assert isinstance(dec, PagedBatchingDecoder) and dec.spec == ""
+
+
+# --- PS end-to-end (the heavy row: measured slow tier) ---
+
+
+@pytest.mark.spec
+@pytest.mark.slow
+def test_ps_serves_with_self_drafting_and_exposes_counters(tmp_path):
+    """KUBEML_SERVING_SPEC=self through the PS: the paged decoder comes up
+    in spec mode, greedy output matches the spec-off PS, the payload
+    carries the spec fields, and the exposition carries the counters."""
+    from kubeml_tpu.api.config import Config
+    from kubeml_tpu.functions.registry import FunctionRegistry
+    from kubeml_tpu.ps.parameter_server import ParameterServer
+    from kubeml_tpu.storage.checkpoint import FINAL_TAG, CheckpointStore
+
+    fn_src = """
+import optax
+from kubeml_tpu.runtime.model import KubeModel
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.models.gpt import CausalTransformer
+
+class Tokens(KubeDataset):
+    def __init__(self):
+        super().__init__("tokens")
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(Tokens())
+    def build(self):
+        return CausalTransformer(vocab_size=64, max_len=32, embed_dim=32,
+                                 depth=2, num_heads=4)
+    def configure_optimizers(self):
+        return optax.adamw(self.lr)
+"""
+    import flax.linen as nn
+
+    module = CausalTransformer(vocab_size=64, max_len=32, embed_dim=32,
+                               depth=2, num_heads=4)
+    variables = module.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.int32))
+    variables = jax.tree.map(np.asarray, nn.meta.unbox(variables))
+    cfg = Config(data_root=tmp_path, serving_slots=2, serving_chunk_steps=4,
+                 serving_page_tokens=4, serving_spec="self",
+                 spec_exit_layer=2, spec_k=2, spec_adaptive=False)
+    cfg.ensure_dirs()
+    reg = FunctionRegistry(config=cfg)
+    reg.create("specfn", fn_src)
+    CheckpointStore(config=cfg).save(
+        "specjob", variables, epoch=1, tag=FINAL_TAG,
+        meta={"request": {"function_name": "specfn"}})
+    ps = ParameterServer(registry=reg, config=cfg)
+    out = ps.generate("specjob", GenerateRequest(
+        prompts=[[1, 2, 3, 4, 5, 6, 7, 8]], max_new_tokens=6))
+    assert out["spec_proposed_tokens"] > 0
+    assert out["spec_accepted_tokens"] >= 0
+    dec = ps._decoders["specjob"][0]
+    assert isinstance(dec, PagedBatchingDecoder) and dec.spec == "self"
+    text = ps.metrics.render()
+    assert 'kubeml_serving_spec_drafted_tokens_total{model="specjob"}' in text
+    assert 'kubeml_serving_spec_k{model="specjob"}' in text
+    # spec off: same checkpoint, same greedy tokens
+    cfg_off = Config(data_root=tmp_path, serving_slots=2,
+                     serving_chunk_steps=4, serving_page_tokens=4)
+    ps2 = ParameterServer(registry=FunctionRegistry(config=cfg_off),
+                          config=cfg_off)
+    out2 = ps2.generate("specjob", GenerateRequest(
+        prompts=[[1, 2, 3, 4, 5, 6, 7, 8]], max_new_tokens=6))
+    assert out2["tokens"] == out["tokens"]
+    assert out2["spec_proposed_tokens"] == 0
