@@ -326,6 +326,16 @@ class Config:
     # prefill runs only on the unshared suffix
     serving_prefix_cache: bool = field(
         default_factory=lambda: _env_bool("KUBEML_SERVING_PREFIX_CACHE", True))
+    # how the paged engine READS the KV arena (ops/paged_attention.py):
+    # "pallas" attends straight through the page table with the streaming
+    # Pallas kernel (KV traffic scales with each row's actual depth, no
+    # contiguous gather copy in HBM), "gather" keeps the
+    # gather-then-attend path (the parity oracle and the off-TPU serving
+    # path), "auto" (default) = pallas on TPU, gather elsewhere. The impl
+    # is cloned onto the served module, so it is part of every jit-cache
+    # key — toggling can never serve a stale compiled program.
+    paged_attn: str = field(
+        default_factory=lambda: os.environ.get("KUBEML_PAGED_ATTN", "auto"))
     # --- speculative decoding (paged engine only; serving/batcher.py
     # spec mode + models/generation.py acceptance math) ---
     # drafter backend: "off" (default), "self" (early-exit logits from a

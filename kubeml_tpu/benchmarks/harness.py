@@ -42,6 +42,11 @@ GATE_METRICS = {
     "spec_accept_ratio": ("spec_accept_ratio", "higher"),
     # serving latency rides the same table with the opposite direction
     "serving_latency_p95_ms": ("latency_p95_ms", "lower"),
+    # paged-attention decode-step cost (results/paged_attn.jsonl rows,
+    # benchmarks/paged_attn_bench.py): per-step wall time of the paged
+    # decode read path — the live-width clamp / Pallas page-walk kernel
+    # regress the gate if a candidate's step gets slower
+    "paged_decode_step_ms": ("decode_step_ms", "lower"),
 }
 
 

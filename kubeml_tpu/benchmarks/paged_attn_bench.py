@@ -1,0 +1,286 @@
+"""Paged-attention decode microbenchmark: step cost vs (seq_len, table width).
+
+The claim under test (ISSUE 15): the ORIGINAL paged decode read gathers each
+row's whole reserved page table every step, so its cost scales with the
+TABLE WIDTH (admission reserves the worst case — a row 64 tokens into a
+1024-token budget pays for 1024); the live-width clamp and the Pallas
+page-walk kernel (ops/paged_attention.py) make cost scale with the actual
+``seq_len``. This bench measures one jitted L=1 decode step of a
+CausalTransformer through three read paths:
+
+* ``gather-full``    — the pre-clamp behavior: full reserved table shipped
+  (the baseline the gate compares against);
+* ``gather-clamped`` — the fallback path as the engine now drives it: the
+  table sliced to the pow2-bucketed live width (satellite win, measurable
+  on CPU — the gather itself shrinks);
+* ``pallas``         — the streaming kernel over the clamped table
+  (per-row live-page reads on top; off-TPU it runs interpret mode, whose
+  TIMINGS are python-loop artifacts — rows carry ``interpret: true`` and
+  the chip is where its wall-clock claim is settled; the modeled
+  ``kv_read_bytes`` column carries the traffic story everywhere).
+
+Rows append to ``results/paged_attn.jsonl``; the gate pair
+(``paged_attn_gate_{baseline,candidate}.json``) feeds
+``scripts/bench_compare.py`` via the ``paged_decode_step_ms``
+lower-is-better metric. ``--serving`` additionally runs the long-workload
+paged serving row (benchmarks/serving.py --long-workload --paged) so the
+``serving_fraction_of_one_shot`` gate tracks the end-to-end effect.
+
+    python -m kubeml_tpu.benchmarks.paged_attn_bench --out results/paged_attn.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from pathlib import Path
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _model(vocab: int, max_len: int, embed: int, depth: int, heads: int):
+    from ..models.gpt import CausalTransformer
+
+    return CausalTransformer(vocab_size=vocab, max_len=max_len,
+                             embed_dim=embed, depth=depth, num_heads=heads)
+
+
+def _pow2(n: int, cap: int) -> int:
+    """The engine's live-width bucket — the SHARED implementation
+    (serving/batcher._bucket_width: pow2 with the 8-page floor), so the
+    bench always measures the table widths the engine actually ships."""
+    from ..serving.batcher import _bucket_width
+
+    return _bucket_width(n, cap)
+
+
+def _prep_paged(module, variables, *, batch: int, seq_len: int, horizon: int,
+                page_tokens: int, impl: str, rng: np.random.Generator):
+    """The shared setup BOTH bench stages use (so timing rows and the
+    token-parity oracle can never measure different configurations): clone
+    the read impl onto the module, build contiguous per-row tables at the
+    engine's bucketed live width — covering ``seq_len`` plus the
+    ``horizon`` positions the caller will decode, exactly like
+    ``_live_table_width``'s pos_cap+advance bound (a narrower table would
+    trash-redirect late writes and silently stop measuring the real
+    configuration) — and prefill ``batch`` rows to ``seq_len``. Returns
+    ``(mod, table, w, table_pages, cache, first_tok)``."""
+    from ..models.generation import init_paged_cache
+
+    cap = int(module.max_len)
+    pt = int(page_tokens)
+    table_pages = -(-cap // pt)
+    paged_attn = "pallas" if impl == "pallas" else "gather"
+    mod = module.clone(page_tokens=pt, kv_pages=batch * table_pages + 1,
+                       paged_attn=paged_attn)
+    # contiguous per-row tables over the arena (page 0 stays the trash page)
+    full = np.asarray(
+        [[1 + r * table_pages + j for j in range(table_pages)]
+         for r in range(batch)], np.int32)
+    if impl == "gather-full":
+        w = table_pages
+    else:
+        w = _pow2(-(-(seq_len + 1 + horizon) // pt), table_pages)
+    table = jnp.asarray(full[:, :w])
+    prompts = jnp.asarray(rng.integers(1, module.vocab_size,
+                                       size=(batch, seq_len)), jnp.int32)
+    cache = init_paged_cache(mod, variables, batch, table_pages)
+    logits, vs = mod.apply(
+        {**variables, "cache": cache}, prompts, decode=True,
+        positions=jnp.zeros((batch,), jnp.int32), pages=table,
+        seq_lens=jnp.full((batch,), seq_len, jnp.int32), mutable=["cache"])
+    tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    return mod, table, w, table_pages, vs["cache"], tok
+
+
+def measure_decode_step(module, variables, *, batch: int, seq_len: int,
+                        page_tokens: int, impl: str, reps: int,
+                        rng: np.random.Generator) -> dict:
+    """One row: prefill ``batch`` rows to ``seq_len``, then time the jitted
+    single-token step through the requested read path / table width."""
+    from ..serving.batcher import _kv_token_bytes
+
+    pt = int(page_tokens)
+    mod, table, w, table_pages, cache, tok = _prep_paged(
+        module, variables, batch=batch, seq_len=seq_len, horizon=reps + 1,
+        page_tokens=page_tokens, impl=impl, rng=rng)
+
+    @jax.jit
+    def step(variables, cache, tok, pos, table):
+        lg, vs = mod.apply({**variables, "cache": cache}, tok[:, None],
+                           decode=True, positions=pos, pages=table,
+                           mutable=["cache"])
+        return jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32), vs["cache"]
+
+    pos = jnp.full((batch,), seq_len, jnp.int32)
+    tok2, cache = step(variables, cache, tok, pos, table)  # compile
+    tok2.block_until_ready()
+    best = float("inf")
+    for i in range(reps):
+        t0 = time.perf_counter()
+        tok2, cache = step(variables, cache, tok2, pos + 1 + i, table)
+        tok2.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    token_bytes = _kv_token_bytes(mod)
+    if impl == "pallas":
+        kv_tokens = batch * min(-(-(seq_len + 1) // pt), w) * pt
+    else:
+        kv_tokens = batch * w * pt
+    return {
+        "metric": "paged-attn-decode-step",
+        "impl": impl,
+        "batch": batch,
+        "seq_len": seq_len,
+        "max_len": int(module.max_len),
+        "page_tokens": pt,
+        "table_pages": w,
+        "reserved_pages": table_pages,
+        "decode_step_ms": round(best * 1000, 3),
+        # host-modeled KV traffic per step (the same geometry model the
+        # kubeml_serving_kv_read_bytes_total counter uses) — the column
+        # that shows kernel reads scaling with seq_len on ANY backend
+        "kv_read_bytes_model": kv_tokens * token_bytes,
+        "interpret": bool(impl == "pallas"
+                          and jax.default_backend() != "tpu"),
+        "backend": jax.default_backend(),
+    }
+
+
+def greedy_chain(module, variables, *, batch: int, prompt_len: int,
+                 steps: int, page_tokens: int, impl: str,
+                 rng: np.random.Generator) -> np.ndarray:
+    """[batch, steps+1] greedy tokens through one read path — the bench's
+    own token-parity oracle (the acceptance gate asserts the three impls
+    emit identical chains before any timing row counts)."""
+    mod, table, _w, _tp, cache, tok = _prep_paged(
+        module, variables, batch=batch, seq_len=prompt_len, horizon=steps,
+        page_tokens=page_tokens, impl=impl, rng=rng)
+    out = [np.asarray(tok)]
+    for i in range(steps):
+        logits, vs = mod.apply(
+            {**variables, "cache": cache}, tok[:, None], decode=True,
+            positions=jnp.full((batch,), prompt_len + i, jnp.int32),
+            pages=table, mutable=["cache"])
+        cache = vs["cache"]
+        tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        out.append(np.asarray(tok))
+    return np.stack(out, axis=1)
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="paged-attention decode-step microbench")
+    p.add_argument("--out", default="results/paged_attn.jsonl")
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--max-len", type=int, default=512)
+    p.add_argument("--page-tokens", type=int, default=16)
+    p.add_argument("--embed", type=int, default=128)
+    p.add_argument("--depth", type=int, default=2)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=1024)
+    p.add_argument("--reps", type=int, default=10)
+    p.add_argument("--seq-lens", default="32,128,448",
+                   help="comma-separated cached depths to measure")
+    p.add_argument("--impls", default="gather-full,gather-clamped,pallas")
+    p.add_argument("--serving", action="store_true",
+                   help="also run the long-workload paged serving row "
+                        "(benchmarks/serving.py --long-workload --paged; "
+                        "heavy — starts a live cluster)")
+    args = p.parse_args(argv)
+
+    module = _model(args.vocab, args.max_len, args.embed, args.depth,
+                    args.heads)
+    rng = np.random.default_rng(0)
+    variables = module.init(jax.random.PRNGKey(0),
+                            np.zeros((1, 8), np.int32))
+    seq_lens = [int(s) for s in args.seq_lens.split(",") if s]
+    impls = [s.strip() for s in args.impls.split(",") if s.strip()]
+    out_path = Path(args.out)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    # drop any previous run's gate pair FIRST: the shell gate keys on these
+    # files existing, and a run that doesn't measure both gather impls must
+    # not let bench_compare pass on stale data it never produced
+    for tag in ("baseline", "candidate"):
+        gp = out_path.parent / f"paged_attn_gate_{tag}.json"
+        if gp.exists():
+            gp.unlink()
+    rows = []
+    # token-parity gate first: every read path must emit the identical
+    # greedy chain before its timings mean anything
+    chains = {impl: greedy_chain(module, variables, batch=args.batch,
+                                 prompt_len=16, steps=8,
+                                 page_tokens=args.page_tokens, impl=impl,
+                                 rng=np.random.default_rng(1))
+              for impl in impls}
+    ref_impl = impls[0]
+    parity = all(np.array_equal(chains[ref_impl], chains[i]) for i in impls)
+    parity_row = {"metric": "paged-attn-token-parity", "impls": impls,
+                  "tokens": int(chains[ref_impl].size), "pass": bool(parity),
+                  "backend": jax.default_backend()}
+    print(json.dumps(parity_row), flush=True)
+    rows.append(parity_row)
+    if not parity:
+        with out_path.open("a") as f:
+            f.write(json.dumps(parity_row) + "\n")
+        raise SystemExit("FAIL: greedy token parity broken across impls")
+    for impl in impls:
+        for seq in seq_lens:
+            if seq + 2 + args.reps > args.max_len:
+                raise SystemExit(f"seq_len {seq} + steps exceeds max_len")
+            row = measure_decode_step(
+                module, variables, batch=args.batch, seq_len=seq,
+                page_tokens=args.page_tokens, impl=impl, reps=args.reps,
+                rng=rng)
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    with out_path.open("a") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+
+    # --- the bench_compare gate pair: candidate = the engine's actual
+    # fallback configuration (clamped gather), baseline = the pre-clamp
+    # full-table gather, at the SHORTEST measured depth — the regime the
+    # clamp exists for (a shallow row under a worst-case reservation). At
+    # the longest depth the clamped width equals the full table and the
+    # comparison would be timing noise between identical programs.
+    shortest = min(seq_lens)
+
+    def pick(impl):
+        for r in rows:
+            if r.get("impl") == impl and r.get("seq_len") == shortest:
+                return r
+        return None
+
+    base, cand = pick("gather-full"), pick("gather-clamped")
+    gate_files = []
+    if base and cand:
+        for tag, row in (("baseline", base), ("candidate", cand)):
+            gp = out_path.parent / f"paged_attn_gate_{tag}.json"
+            gp.write_text(json.dumps(row))
+            gate_files.append(str(gp))
+        print(json.dumps({"gate_files": gate_files}), flush=True)
+
+    if args.serving:
+        from . import serving as serving_bench
+
+        ref = serving_bench.one_shot_rate(8, 256)
+        row = serving_bench.run_load(8, 20.0, 8, 16, new_tokens=256,
+                                     paged=True, mixed_prompts=True,
+                                     long_workload=True)
+        row["batchN_decode_rate"] = round(ref, 1)
+        row["fraction_of_batchN"] = round(row["value"] / ref, 3)
+        with out_path.open("a") as f:
+            f.write(json.dumps(row) + "\n")
+        print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(run())

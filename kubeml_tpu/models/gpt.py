@@ -61,6 +61,13 @@ class CausalSelfAttention(nn.Module):
     # padding every row to max_len. 0/0 (default) = dense cache only.
     page_tokens: int = 0
     kv_pages: int = 0
+    # how the paged path READS the arena (KUBEML_PAGED_ATTN): "gather"
+    # materializes each row's table as a contiguous [B, tw*pt, H, D] block
+    # and attends over it (the original path — the parity oracle);
+    # "pallas" attends straight through the page table with the streaming
+    # kernel (ops/paged_attention.py — KV traffic scales with occupancy,
+    # no contiguous copy); "auto" = pallas on TPU, gather elsewhere
+    paged_attn: str = "auto"
 
     @nn.compact
     def __call__(self, x, valid, decode: bool = False, positions=None,
@@ -114,12 +121,14 @@ class CausalSelfAttention(nn.Module):
                 # padding, rows the host retired) are redirected to
                 # physical page 0 — the pool's reserved trash page — so a
                 # stale row can never corrupt a reallocated page. Reads
-                # gather the row's whole table back into a contiguous
-                # [B, P*pt, H, D] block (one gather per layer per step; the
-                # Pallas per-page-DMA kernel is the chip follow-up) and
                 # attend under the purely positional causal mask — every
                 # logical position <= the query's is real by construction
-                # (prompts are dense, decode writes are contiguous).
+                # (prompts are dense, decode writes are contiguous) —
+                # either straight through the page table (the Pallas
+                # streaming kernel, ops/paged_attention.py) or by
+                # gathering the row's whole table into a contiguous
+                # [B, tw*pt, H, D] block (the fallback + parity oracle);
+                # ``paged_attn`` selects.
                 if self.page_tokens <= 0 or self.kv_pages <= 0:
                     raise ValueError(
                         "paged decode needs page_tokens/kv_pages > 0 on the "
@@ -154,11 +163,24 @@ class CausalSelfAttention(nn.Module):
                 off = pos_full % pt
                 ck.value = ck.value.at[phys, off].set(k)
                 cv.value = cv.value.at[phys, off].set(v)
-                kg = ck.value[pages].reshape(B, tw * pt, H, D)
-                vg = cv.value[pages].reshape(B, tw * pt, H, D)
-                k_pos = jnp.arange(tw * pt)[None, None, None, :]
-                mask = k_pos <= pos_full[:, None, :, None]  # [B, 1, L, tw*pt]
-                out = dot_product_attention(q, kg, vg, mask=mask)
+                from ..ops.paged_attention import resolve_paged_attn
+
+                if resolve_paged_attn(self.paged_attn) == "pallas":
+                    # stream pages through VMEM with the online-softmax
+                    # kernel: the arena gather happens per block inside
+                    # the kernel's DMA walk and reads stop at each row's
+                    # live depth — no [B, tw*pt, H, D] copy in HBM
+                    from ..ops.paged_attention import paged_attention
+
+                    out = paged_attention(q, ck.value, cv.value, pages,
+                                          positions)
+                else:
+                    kg = ck.value[pages].reshape(B, tw * pt, H, D)
+                    vg = cv.value[pages].reshape(B, tw * pt, H, D)
+                    k_pos = jnp.arange(tw * pt)[None, None, None, :]
+                    # [B, 1, L, tw*pt]
+                    mask = k_pos <= pos_full[:, None, :, None]
+                    out = dot_product_attention(q, kg, vg, mask=mask)
                 return out_proj(out.reshape(B, L, H * D))
             Lc = self.cache_len
             ck = self.variable("cache", "k", jnp.zeros, (B, Lc, H, D), k.dtype)
@@ -269,6 +291,7 @@ class GPTBlock(nn.Module):
     rope_theta: float = 10000.0
     page_tokens: int = 0
     kv_pages: int = 0
+    paged_attn: str = "auto"
 
     @nn.compact
     def __call__(self, x, valid, train: bool = False, decode: bool = False,
@@ -282,6 +305,7 @@ class GPTBlock(nn.Module):
                                 rope=self.rope, rope_theta=self.rope_theta,
                                 page_tokens=self.page_tokens,
                                 kv_pages=self.kv_pages,
+                                paged_attn=self.paged_attn,
                                 name="attn")(y, valid, decode=decode,
                                              positions=positions,
                                              pages=pages, seq_lens=seq_lens)
@@ -342,9 +366,13 @@ class CausalTransformer(nn.Module):
     moe_capacity: float = 1.25
     # --- paged KV cache (decode only; kubeml_tpu.serving.kvpool clones
     # these in — page_tokens tokens per physical page, kv_pages pages in
-    # the shared arena). 0/0 keeps the dense per-row cache. ---
+    # the shared arena). 0/0 keeps the dense per-row cache. ``paged_attn``
+    # picks the arena READ path: "pallas" streams pages through the
+    # ops/paged_attention.py kernel, "gather" materializes the table as a
+    # contiguous block (parity oracle), "auto" = pallas on TPU only. ---
     page_tokens: int = 0
     kv_pages: int = 0
+    paged_attn: str = "auto"
 
     @nn.compact
     def __call__(self, token_ids, train: bool = False, decode: bool = False,
@@ -456,6 +484,7 @@ class CausalTransformer(nn.Module):
                                   rope=use_rope, rope_theta=self.rope_theta,
                                   page_tokens=self.page_tokens,
                                   kv_pages=self.kv_pages,
+                                  paged_attn=self.paged_attn,
                                   name=f"block_{i}")
                 # positions only exists on the decode path, which never remats
                 # — keeping the training call positional preserves the remat

@@ -1,6 +1,7 @@
 from .attention import dot_product_attention
 from .flash_attention import flash_attention
 from .int8_matmul import int8_dot, int8_matmul
+from .paged_attention import paged_attention
 
 __all__ = ["dot_product_attention", "flash_attention", "int8_dot",
-           "int8_matmul"]
+           "int8_matmul", "paged_attention"]

@@ -1,0 +1,212 @@
+"""Pallas TPU paged-attention decode kernel: attend straight through the
+page table, no contiguous K/V copy, KV traffic that scales with occupancy.
+
+The paged serving engine (serving/kvpool.py + the paged decode branch in
+models/gpt.py) stores K/V in one shared physical arena
+``[kv_pages, page_tokens, H, D]`` addressed through per-row page tables.
+The original decode read was gather-then-attend: every step, every layer,
+each row's WHOLE table is gathered into a contiguous ``[B, tw*pt, H, D]``
+HBM block and plain attention runs over it — so a row 64 tokens into a
+1024-token reservation reads (and materializes a copy of) 1024 tokens of K
+and V per layer per step, because admission reserves the worst case. This
+kernel is the vLLM PagedAttention / Flash-Decoding answer (Kwon et al.,
+SOSP 2023): stream the row's pages through VMEM with the online-softmax
+recurrence, so no contiguous copy ever exists and reads stop at the row's
+live depth.
+
+Grid layout — the kv axis WALKS THE PAGE TABLE: grid ``(B, H, P)`` with the
+page index innermost (sequential on TPU). The page table, per-row positions
+and per-row live-page counts ride ``PrefetchScalarGridSpec`` scalar
+prefetch, so the K/V BlockSpec index maps translate the LOGICAL page index
+``i`` into the row's PHYSICAL arena page before the block is fetched — the
+"gather" happens per VMEM block inside the kernel's DMA stream, never as a
+materialized HBM tensor. The online-softmax carry (acc/m/l) lives in VMEM
+scratch across the page axis exactly like ops/flash_attention.py, and the
+output block is revisited (constant index map along the page axis) so it is
+written once at the final step.
+
+Per-row depth clamp — grid steps past a row's last live page repeat the
+previous physical index (the index map clamps at ``live[b] - 1``, the same
+trick the flash kernels use at the causal diagonal), so Pallas elides their
+HBM->VMEM copies, and ``pl.when(i < live[b])`` skips their compute: HBM
+reads and FLOPs scale with the row's ACTUAL ``positions + L``, not the
+reserved table width. Dead rows the host already retired point at the
+pool's trash page 0; their output is garbage the engine discards anyway
+(exactly the gather path's contract).
+
+One kernel serves all three paged callers: L == 1 decode steps, L == k+1
+speculative verify windows, and L > 1 page-aligned suffix prefill after a
+prefix hit — the mask is purely positional (``k_pos <= positions[b] + l``),
+identical to the gather path's, so every logical position at or before the
+query is attended and later positions (incl. everything past the live
+clamp) are not. ``interpret=True`` (automatic off-TPU) runs the same kernel
+on CPU for the parity suite (tests/test_paged_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# large-negative instead of -inf keeps exp() NaN-free for fully masked rows
+# (same trick as ops/flash_attention.py)
+_NEG = -1e30
+
+# lane width of the m/l carry scratch (scalar-per-row state broadcast across
+# the minor dimension so the scratch tiles legally)
+_LANES = 128
+
+VALID_IMPLS = ("auto", "pallas", "gather")
+
+
+def resolve_paged_attn(value: Optional[str]) -> str:
+    """Resolve a ``KUBEML_PAGED_ATTN`` value to a concrete implementation:
+    ``auto`` (default) takes the Pallas kernel on TPU and the gather path
+    everywhere else (interpret-mode Pallas is a numerics oracle, not a
+    serving path); ``pallas``/``gather`` force their path (the forced
+    kernel runs interpret mode off-TPU — the test configuration)."""
+    v = (value or "auto").lower()
+    if v not in VALID_IMPLS:
+        raise ValueError(
+            f"unknown paged-attention impl {value!r} (valid: "
+            f"{', '.join(VALID_IMPLS)})")
+    if v == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "gather"
+    return v
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _pa_kernel(pages_ref, pos_ref, live_ref, q_ref, k_ref, v_ref, o_ref,
+               acc_ref, m_ref, l_ref, *, page_tokens: int, n_pages: int,
+               scale: float):
+    """One (batch row, head, logical page) program. The page axis is the
+    innermost (sequential) grid dimension; acc/m/l carry across it in VMEM
+    scratch, and the output is written at the final page step."""
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    lq = q_ref.shape[2]
+    pt = page_tokens
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # pages at or past the row's live depth contribute nothing: their copies
+    # were elided by the clamped index map, their compute is skipped here
+    @pl.when(i < live_ref[b])
+    def _step():
+        q = q_ref[0, 0]           # [Lq, D] (storage dtype; f32 accumulate)
+        k_pg = k_ref[0, :, 0, :]  # [pt, D] — one physical page, this head
+        v_pg = v_ref[0, :, 0, :]
+        s = jax.lax.dot_general(
+            q, k_pg, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [Lq, pt]
+        # purely positional mask, identical to the gather path: query l sits
+        # at logical position positions[b] + l and attends every key at or
+        # before it (prompts are dense, decode writes contiguous — every
+        # earlier position is real by construction). Padded query rows
+        # (l >= the caller's true L) produce garbage that is sliced off.
+        q_pos = pos_ref[b] + jax.lax.broadcasted_iota(jnp.int32, (lq, pt), 0)
+        k_pos = i * pt + jax.lax.broadcasted_iota(jnp.int32, (lq, pt), 1)
+        s = jnp.where(k_pos <= q_pos, s, _NEG)
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(s <= _NEG / 2, 0.0, p)  # masked keys stay exactly 0
+        l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_pg.dtype), v_pg, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == n_pages - 1)
+    def _finalize():
+        l = l_ref[:, 0:1]
+        o_ref[0, 0] = (acc_ref[...] / jnp.maximum(l, 1e-9)).astype(o_ref.dtype)
+
+
+def paged_attention(
+    q: jnp.ndarray,         # [B, L, H, D] this call's queries
+    k_pages: jnp.ndarray,   # [N, pt, H, D] physical K arena (post-write)
+    v_pages: jnp.ndarray,   # [N, pt, H, D] physical V arena (post-write)
+    pages: jnp.ndarray,     # [B, P] int32 per-row page table
+    positions: jnp.ndarray,  # [B] int32 logical position of q[:, 0]
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Paged decode attention; returns ``[B, L, H, D]``.
+
+    Numerically equivalent (at f32-accumulation tolerance) to gathering
+    ``k_pages[pages]`` into a contiguous ``[B, P*pt, H, D]`` block and
+    attending under the positional causal mask — without the gather: the
+    kernel walks each row's table page by page. Callers must have already
+    scattered this call's K/V into the arenas (the paged decode branch in
+    models/gpt.py writes first, then attends)."""
+    B, L, H, D = q.shape
+    pt = int(k_pages.shape[1])
+    P = int(pages.shape[1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # queries move to [B, H, Lp, D] so the block's trailing dims are a clean
+    # (Lp, D) tile; L pads up to the f32 sublane minimum (padded rows are
+    # sliced off — L is 1 on the decode step path)
+    lqp = _round_up(max(L, 8), 8)
+    qt = jnp.moveaxis(q, 2, 1)
+    qt = jnp.pad(qt, ((0, 0), (0, 0), (0, lqp - L), (0, 0)))
+    pages = pages.astype(jnp.int32)
+    positions = positions.astype(jnp.int32)
+    # pages the row actually occupies after this call's writes: the stream
+    # clamp. At least one page (a fresh row still reads its own first
+    # write); at most the table width (bucket-padding rows whose nominal
+    # positions run past the table just re-read their last page — their
+    # output is discarded, matching the gather path's clip).
+    live = jnp.clip((positions + L + pt - 1) // pt, 1, P)
+    scale = 1.0 / math.sqrt(D)
+
+    def q_map(b, h, i, pages_ref, pos_ref, live_ref):
+        return (b, h, 0, 0)
+
+    def kv_map(b, h, i, pages_ref, pos_ref, live_ref):
+        # logical->physical through the prefetched table; steps past the
+        # row's live depth repeat the previous physical page so Pallas
+        # elides their copies (the flash kernels' causal-diagonal trick,
+        # applied to per-row occupancy)
+        pg = jnp.maximum(jnp.minimum(i, live_ref[b] - 1), 0)
+        return (pages_ref[b, pg], 0, h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # pages, positions, live
+        grid=(B, H, P),
+        in_specs=[
+            pl.BlockSpec((1, 1, lqp, D), q_map),
+            pl.BlockSpec((1, pt, 1, D), kv_map),
+            pl.BlockSpec((1, pt, 1, D), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, lqp, D), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((lqp, D), jnp.float32),       # acc
+            pltpu.VMEM((lqp, _LANES), jnp.float32),  # m (row max)
+            pltpu.VMEM((lqp, _LANES), jnp.float32),  # l (row sum)
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_pa_kernel, page_tokens=pt, n_pages=P, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, lqp, D), q.dtype),
+        interpret=interpret,
+    )(pages, positions, live, qt, k_pages, v_pages)
+    return jnp.moveaxis(out[:, :, :L], 1, 2)

@@ -68,6 +68,12 @@ LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
 OCCUPANCY_BUCKETS = (0.0625, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875,
                      0.9375, 1.0)
 
+# log-scaled bytes/sec edges for the achieved-KV-bandwidth histogram
+# (serving/stats.py kv_read): spans a tunneled dev box's ~MB/s through a
+# v5e's ~800 GB/s HBM
+BANDWIDTH_BUCKETS = (1e6, 3e6, 1e7, 3e7, 1e8, 3e8, 1e9, 3e9, 1e10, 3e10,
+                     1e11, 3e11, 1e12)
+
 
 class Histogram:
     """Minimal Prometheus histogram: fixed bucket edges, cumulative counts,
@@ -194,6 +200,16 @@ SERVING_COUNTERS = {
     "kubeml_serving_wasted_tokens_total": (
         "wasted_tokens", "Tokens routed to a request whose waiter already "
                          "gave up (timeout/cancel)"),
+    # KV-read accounting (ISSUE 15, ops/paged_attention.py): decode-path
+    # attention reads, host-modeled from the table geometry each dispatch
+    # shipped — gather reads rows x gathered width, the Pallas kernel only
+    # each row's live pages, so this counter's rate is where the paged
+    # kernel's traffic win (and the live-width gather clamp) shows up
+    "kubeml_serving_kv_read_bytes_total": (
+        "kv_read_bytes", "KV-cache bytes the decode-path attention read "
+                         "(host-modeled from dispatched table geometry: "
+                         "gather = rows x table width, Pallas kernel = "
+                         "live pages only)"),
     # shared-prefix reuse (paged engine, serving/kvpool.py)
     "kubeml_serving_prefix_hits_total": (
         "prefix_hits", "Admissions whose leading prompt blocks were served "
@@ -276,6 +292,9 @@ SERVING_HISTOGRAMS = {
                      "drained rows)"),
     "kubeml_serving_batch_occupancy_ratio": (
         "occupancy_ratio", "Per-chunk live fraction of device slot-steps"),
+    "kubeml_serving_kv_bandwidth_bytes_per_sec": (
+        "kv_bandwidth", "Achieved KV-read bandwidth per decode chunk "
+                        "(modeled bytes over the chunk's fetch wall time)"),
     "kubeml_serving_spec_accept_ratio": (
         "spec_accept_ratio", "Per-verify-step speculative acceptance ratio "
                              "(accepted / drafted)"),
@@ -341,6 +360,11 @@ SERVING_GAUGES = {
     "kubeml_serving_prefix_cache_pages": (
         "prefix_cache_pages", "Pages currently held by the shared-prefix "
                               "trie (evictable when unreferenced)"),
+    "kubeml_serving_paged_attn_pallas": (
+        "paged_attn_kernel", "1 when the paged engine attends through the "
+                             "Pallas paged-attention kernel "
+                             "(KUBEML_PAGED_ATTN), 0 on the gather "
+                             "fallback"),
     # speculative decoding (spec-mode decoders only)
     "kubeml_serving_spec_accept_rate": (
         "spec_accept_rate", "Lifetime speculative acceptance rate "

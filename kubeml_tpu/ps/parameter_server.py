@@ -1346,7 +1346,8 @@ class ParameterServer:
                 and supports_paged_decode(module)):
             paged_kw = dict(page_tokens=self.cfg.serving_page_tokens,
                             pages=self.cfg.serving_pages,
-                            prefix_cache=self.cfg.serving_prefix_cache)
+                            prefix_cache=self.cfg.serving_prefix_cache,
+                            paged_attn=self.cfg.paged_attn)
             spec_kw = self._spec_decoder_args(module)
             try:
                 decoder = PagedBatchingDecoder(module, variables,

@@ -227,6 +227,13 @@ class _Row:
     lease: Optional[object] = None  # kvpool.PageLease while pages are held
     prefix_cached: int = 0          # prompt tokens served from the prefix trie
     dispatched: int = 0             # post-admit steps already in the chain
+    # host-side UPPER BOUND on the row's device write cursor across the
+    # dispatch chain (prompt_len at admission, += chunk size per plain
+    # chunk, += k+1 per spec macro-step, clamped at the row's final
+    # position): the live-table-width clamp sizes each dispatch's page
+    # table from this, so a clamped program can never trash-redirect a
+    # write the device actually makes
+    pos_cap: int = 0
     # speculative decoding (spec mode): candidate tokens this row sent
     # through batched verification, and drafted tokens accepted
     spec_proposed: int = 0
@@ -288,6 +295,43 @@ def _pow2_bucket(n: int, lo: int, hi: int) -> int:
     while b < n:
         b *= 2
     return min(b, hi)
+
+
+# floor of the live-table-width pow2 bucket (PagedBatchingDecoder): every
+# distinct (chunk size, table width) pair is its own compiled program, and
+# widths below 8 pages save almost no bytes while doubling the program set
+_MIN_TABLE_BUCKET = 8
+
+
+def _bucket_width(need: int, cap: int) -> int:
+    """THE live-table-width bucket: ``need`` pages rounded up the pow2
+    ladder from the ``_MIN_TABLE_BUCKET`` floor, capped at the full table.
+    One definition shared by chunk dispatch, admission and the microbench
+    (benchmarks/paged_attn_bench.py) so the bench always measures the
+    widths the engine actually ships."""
+    need = max(need, min(cap, _MIN_TABLE_BUCKET))
+    w = 1
+    while w < need:
+        w *= 2
+    return min(w, cap)
+
+
+def _kv_token_bytes(module, layers: Optional[int] = None) -> int:
+    """HBM bytes attention reads per CACHED TOKEN per forward pass: every
+    layer reads the token's K and V rows once. The KV-read accounting
+    (kubeml_serving_kv_read_bytes_total) multiplies this by the
+    host-modeled gathered-token count per dispatch — a geometry model of
+    the device's read traffic, not a hardware counter. 0 when the module
+    doesn't expose the transformer geometry (accounting is skipped)."""
+    import jax.numpy as jnp
+
+    depth = layers if layers is not None else getattr(module, "depth", None)
+    heads = getattr(module, "num_heads", None)
+    embed = getattr(module, "embed_dim", None)
+    if not depth or not heads or not embed:
+        return 0
+    itemsize = jnp.dtype(getattr(module, "dtype", jnp.float32)).itemsize
+    return int(depth) * 2 * int(embed) * int(itemsize)
 
 
 class _FetchPool:
@@ -498,6 +542,12 @@ class BatchingDecoder:
         from .quant import quantized_bytes
 
         self.weight_bytes = quantized_bytes(self._variables)
+        # KV-read accounting constant (stats.kv_read / the
+        # kubeml_serving_kv_read_bytes_total counter): HBM bytes attention
+        # reads per cached token per forward pass. The dense slab engine
+        # reads its full [S, max_len] stripes every step; the paged engine
+        # overrides per dispatch with the table geometry actually shipped.
+        self._kv_token_bytes = _kv_token_bytes(module)
         self._pending: deque = deque()
         self._slot_rows: List[Optional[_Row]] = [None] * self.slots
         # rows whose slot was pre-freed but whose results are still in
@@ -1219,10 +1269,16 @@ class BatchingDecoder:
 
     def _materialize(self, rec: tuple) -> tuple:
         """Runs on a fetcher thread: the value fetch (the only reliable
-        barrier on the tunneled platform), returning a host-data record."""
+        barrier on the tunneled platform), returning a host-data record.
+        The fetch wall time rides the record — it is the chunk's device
+        execution barrier, so wall/steps is the decode-step latency and
+        kv_bytes/wall the achieved KV-read bandwidth."""
+        t0 = time.monotonic()
         if rec[0] == "admit":
-            return ("admit", rec[1], np.asarray(rec[2]))
-        return ("chunk", np.asarray(rec[1]), rec[2])
+            return ("admit", rec[1], np.asarray(rec[2]), rec[3],
+                    time.monotonic() - t0)
+        return ("chunk", np.asarray(rec[1]), rec[2], rec[3],
+                time.monotonic() - t0)
 
     def _group_admits(self, admits: List[tuple]) -> List[List[tuple]]:
         """Split an admission wave into same-prompt-bucket groups (each group
@@ -1286,7 +1342,9 @@ class BatchingDecoder:
         # positions; everything beyond the real prompts (bucket padding +
         # the rows repeated to pad the group to S) is padding compute
         self.stats.admit_tokens(real_tokens, k * bucket - real_tokens)
-        return ("admit", group, packed)
+        # one prefill forward attends over the fresh [k, max_len] caches
+        return ("admit", group, packed,
+                k * self.max_len * self._kv_token_bytes)
 
     def _dispatch_chunk(self, needed: int) -> tuple:
         """Enqueue one multi-token step program sized to the work: the
@@ -1318,15 +1376,20 @@ class BatchingDecoder:
         for slot in range(self.slots):
             self._steps_ahead[slot] += size
         self.stats.chunk()
-        return ("chunk", packed, list(self._slot_rows))
+        # every step re-reads the whole [S, max_len] K and V stripes
+        return ("chunk", packed, list(self._slot_rows),
+                size * self.slots * self.max_len * self._kv_token_bytes)
 
     def _process_record(self, rec: tuple) -> None:
         """Fetch one in-flight program's packed results (ONE np.asarray — the
         value fetch is the only reliable barrier on the tunneled platform,
         and each fetch pays a full round trip) and route its tokens."""
         if rec[0] == "admit":
-            _, group, packed = rec
+            _, group, packed, kv_bytes, _fetch_s = rec
             packed = np.asarray(packed)  # [k, 2] (first, live0)
+            # prefill KV reads count toward the byte total; the per-chunk
+            # bandwidth observation stays a DECODE-path signal
+            self.stats.kv_read(kv_bytes)
             # first processed result of EITHER kind flips the cold-start
             # allowance off: admit-only traffic (max_new_tokens=1) must not
             # keep inflating client timeouts forever; a later first chunk
@@ -1345,12 +1408,14 @@ class BatchingDecoder:
                 if not bool(packed[i, 1]):
                     self._complete_row(slot, row)
             return
-        _, packed, snapshot = rec
-        t_fetch = time.monotonic()
+        _, packed, snapshot, kv_bytes, fetch_s = rec
         packed = np.asarray(packed)  # [T, S]; -1 = not emitted
-        # decode-step histogram feed: the blocking fetch waits on the chunk's
-        # device execution, so wall/steps is the per-step decode latency
-        self.stats.chunk_fetched(time.monotonic() - t_fetch, packed.shape[0])
+        # decode-step histogram feed: the blocking fetch (measured in
+        # _materialize, where the np.asarray actually waits on the device)
+        # is the chunk's execution barrier, so wall/steps is the per-step
+        # decode latency — and kv_bytes/wall the achieved KV bandwidth
+        self.stats.chunk_fetched(fetch_s, packed.shape[0])
+        self.stats.kv_read(kv_bytes, fetch_s)
         self._warmed = True
         # batch-occupancy truth, per device step: live = the device emitted
         # a token (its live flag was up), dead = a row was resident in this
@@ -1570,7 +1635,8 @@ class PagedBatchingDecoder(BatchingDecoder):
                  spec: str = "", spec_k: Optional[int] = None,
                  spec_adaptive: Optional[bool] = None,
                  draft_module=None, draft_variables=None,
-                 spec_exit_layer: Optional[int] = None, **kw):
+                 spec_exit_layer: Optional[int] = None,
+                 paged_attn: Optional[str] = None, **kw):
         if mesh is not None:
             raise ValueError(
                 "paged serving does not run on a mesh yet; use the dense "
@@ -1608,6 +1674,19 @@ class PagedBatchingDecoder(BatchingDecoder):
         use_trie = bool(prefix_cache if prefix_cache is not None
                         else cfg.serving_prefix_cache)
         self._pool = KVPool(npages, pt, prefix_cache=use_trie)
+        # --- paged-attention read path (KUBEML_PAGED_ATTN=auto|pallas|
+        # gather, ops/paged_attention.py): resolved HERE and cloned onto
+        # the module, so the impl is part of the module identity every jit
+        # trace sees — toggling the knob builds a fresh decoder with fresh
+        # programs, never a stale one. Modules predating the field keep
+        # the gather path.
+        from ..ops.paged_attention import resolve_paged_attn
+
+        impl = resolve_paged_attn(paged_attn if paged_attn is not None
+                                  else cfg.paged_attn)
+        if not hasattr(module, "paged_attn"):
+            impl = "gather"
+        self.paged_attn = impl
         # --- speculative decoding (KUBEML_SERVING_SPEC=draft|self|off) ---
         if spec in ("off", None):
             spec = ""
@@ -1639,8 +1718,11 @@ class PagedBatchingDecoder(BatchingDecoder):
                     f"the target's ({cap})")
             # the drafter addresses THE SAME page ids through its own
             # arena, so shared-prefix pages carry valid draft K/V too
+            # (and reads it through the same attention impl)
+            dkw = ({"paged_attn": impl}
+                   if hasattr(draft_module, "paged_attn") else {})
             self.draft_module = draft_module.clone(page_tokens=pt,
-                                                   kv_pages=npages)
+                                                   kv_pages=npages, **dkw)
         elif spec == "self":
             depth = getattr(module, "depth", None)
             e = int(spec_exit_layer if spec_exit_layer
@@ -1665,8 +1747,21 @@ class PagedBatchingDecoder(BatchingDecoder):
         self._spec_lookahead = k_cap if spec else 0
         # the arena dims ride the module as clone fields so the flax cache
         # variables know their shapes (params are untouched by the clone)
-        module = module.clone(page_tokens=pt, kv_pages=npages)
+        clone_kw = dict(page_tokens=pt, kv_pages=npages)
+        if hasattr(module, "paged_attn"):
+            clone_kw["paged_attn"] = impl
+        module = module.clone(**clone_kw)
         super().__init__(module, variables, mesh=None, **kw)
+        # drafter KV-read constant for the spec accounting: the early-exit
+        # self-drafter reads only its truncated stack's layers; a separate
+        # draft model reads its own geometry
+        if spec == "self":
+            self._kv_draft_token_bytes = _kv_token_bytes(
+                module, layers=self.spec_exit_layer)
+        elif spec == "draft":
+            self._kv_draft_token_bytes = _kv_token_bytes(self.draft_module)
+        else:
+            self._kv_draft_token_bytes = 0
         if spec == "draft":
             from .quant import is_quantized_tree, quantize_tree
 
@@ -1895,13 +1990,25 @@ class PagedBatchingDecoder(BatchingDecoder):
         return slab2, dc_ret, out.T, stats
 
     def _dispatch_spec_chunk(self, k: int) -> tuple:
-        # the table ships as a copy for the same aliasing reason as
-        # _dispatch_chunk_paged
+        # a verify window reads/writes up to k+1 positions past each row's
+        # cursor; the table ships clamped to the live width and as a copy
+        # for the same aliasing reason as _dispatch_chunk_paged
+        w = self._live_table_width(k + 1)
         self._slab, dc, packed, stats = self._spec_steps[k](
-            self._variables, self._slab, jnp.asarray(self._table.copy()),
+            self._variables, self._slab,
+            jnp.asarray(self._table[:, :w].copy()),
             self._draft_variables, self._draft_cache)
         if self.spec == "draft":
             self._draft_cache = dc
+        # KV model: drafter iteration i reads i positions past the cursor
+        # (k iterations, +1 write-only in draft mode), the verify forward
+        # reads the whole k+1-deep window once
+        iters = k + 1 if self.spec == "draft" else k
+        kv_bytes = (self._chunk_kv_tokens(w, k + 1) * self._kv_token_bytes
+                    + sum(self._chunk_kv_tokens(w, i)
+                          for i in range(1, iters + 1))
+                    * self._kv_draft_token_bytes)
+        self._bump_pos_caps(k + 1)
         for row in self._slot_rows:
             if row is not None and not row.done and not row.canceled:
                 # a live row emits AT LEAST one token per macro-step, so
@@ -1909,20 +2016,23 @@ class PagedBatchingDecoder(BatchingDecoder):
                 # actual count lands with the results)
                 row.dispatched += 1
         self.stats.chunk()
-        return ("spec", packed, stats, list(self._slot_rows), k)
+        return ("spec", packed, stats, list(self._slot_rows), k, kv_bytes)
 
     def _materialize(self, rec: tuple) -> tuple:
         if rec[0] == "spec":
+            t0 = time.monotonic()
             return ("spec", np.asarray(rec[1]), np.asarray(rec[2]),
-                    rec[3], rec[4])
+                    rec[3], rec[4], rec[5], time.monotonic() - t0)
         return super()._materialize(rec)
 
     def _process_record(self, rec: tuple) -> None:
         if rec[0] != "spec":
             return super()._process_record(rec)
-        _, packed, stats_arr, snapshot, k = rec
+        _, packed, stats_arr, snapshot, k, kv_bytes, fetch_s = rec
         self._warmed = True
-        self.stats.chunk_fetched(0.0, 0)  # fetched by the pool already
+        # no decode-step observation (a macro-step is k+1 tokens wide, not
+        # a per-token step) — but the KV reads and their bandwidth are real
+        self.stats.kv_read(kv_bytes, fetch_s)
         emitted_mask = packed >= 0  # [k+1, S]
         live_steps = int(emitted_mask.sum())
         resident = [s for s, r in enumerate(snapshot) if r is not None]
@@ -2007,7 +2117,13 @@ class PagedBatchingDecoder(BatchingDecoder):
         topks = np.zeros((k,), np.int32)
         eoss = np.zeros((k,), np.int32)
         keys = np.zeros((k, 2), np.uint32)
-        ptbl = np.zeros((k, self.table_pages), np.int32)
+        # prefill touches only positions < prompt_len: the page table ships
+        # clamped to the live width (the shared pow2-with-floor bucket),
+        # not the full worst-case reservation
+        pt = self.page_tokens
+        wa = _bucket_width(
+            max(-(-len(r.prompt) // pt) for _, r in group), self.table_pages)
+        ptbl = np.zeros((k, wa), np.int32)
         for i, (slot, row) in enumerate(padded_group):
             pre = row.lease.prefix_tokens
             sfx = row.prompt[pre:]
@@ -2015,7 +2131,8 @@ class PagedBatchingDecoder(BatchingDecoder):
             base[i] = pre
             slens[i] = len(sfx)
             rowids[i] = slot
-            ptbl[i, :len(row.lease.pages)] = row.lease.pages
+            pgs = row.lease.pages[:wa]
+            ptbl[i, :len(pgs)] = pgs
             max_news[i] = row.max_new
             temps[i] = row.temp
             topks[i] = row.topk
@@ -2039,6 +2156,7 @@ class PagedBatchingDecoder(BatchingDecoder):
             self._table[slot, :] = 0
             self._table[slot, :len(row.lease.pages)] = row.lease.pages
             row.dispatched = 0
+            row.pos_cap = len(row.prompt)  # device cursor lands at plen
             row.slot_at = now
             self.stats.phase("queue_wait", now - row.entry.submitted_at)
             real_tokens += len(row.prompt) - row.lease.prefix_tokens
@@ -2051,7 +2169,18 @@ class PagedBatchingDecoder(BatchingDecoder):
         # prefix-cached tokens are the measured FLOP saving, padding is the
         # bucket + repeated-row compute
         self.stats.admit_tokens(real_tokens, k * bucket - real_tokens)
-        return ("admit", group, packed)
+        # KV model for the prefill forward(s): gather reads every program
+        # row's clamped table, the kernel stops at each row's prompt depth;
+        # a draft-backend admission prefills the drafter's arena too
+        if self.paged_attn == "pallas":
+            span = sum(min(-(-len(r.prompt) // pt), wa) * pt
+                       for _, r in padded_group)
+        else:
+            span = k * wa * pt
+        kv_bytes = span * self._kv_token_bytes
+        if self.spec == "draft":
+            kv_bytes += span * self._kv_draft_token_bytes
+        return ("admit", group, packed, kv_bytes)
 
     # --- the decode chunk (pow2 ladder to the earliest completion) ---
 
@@ -2069,19 +2198,82 @@ class PagedBatchingDecoder(BatchingDecoder):
                 size = t
         return size
 
+    def _live_table_width(self, extra: int) -> int:
+        """Pow2-bucketed page-table width covering every resident row's
+        reads AND writes for a dispatch that advances each row at most
+        ``extra`` positions past its ``pos_cap`` (the host-side cursor
+        upper bound). Shipping only the live width — instead of the full
+        reserved ``table_pages`` — is the fallback path's cheap win (the
+        gather shrinks from the worst-case reservation to what the batch
+        actually occupies) and bounds the kernel's grid the same way; the
+        pow2 bucket keeps the compiled-program set at log2(table_pages)
+        widths. Capped per row at its lease width: positions beyond the
+        reservation were trash-bound in the full-width program too (zero
+        table entries), so the clamp is behavior-preserving. The bucket
+        FLOORS at 8 pages (or the whole table when smaller): sub-8 widths
+        barely cut bytes but each is another (chunk, width) XLA compile —
+        the clamp's win lives in the deep-reservation regime (a 2048-token
+        max_len is 128 pages at pt=16; a 256-token chat row stays in a
+        16-32 page bucket)."""
+        pt = self.page_tokens
+        need = 1
+        for row in self._slot_rows:
+            if row is None or row.lease is None:
+                continue
+            need = max(need, min(-(-(row.pos_cap + extra) // pt),
+                                 len(row.lease.pages)))
+        return _bucket_width(need, self.table_pages)
+
+    def _bump_pos_caps(self, adv: int) -> None:
+        """Advance every resident row's host-side cursor upper bound after
+        a dispatch: a plain chunk moves a row at most its step count, a
+        spec macro-step at most k+1, and no row ever writes past its final
+        position (the device clamps via remaining/live)."""
+        for row in self._slot_rows:
+            if row is not None and not row.done and not row.canceled:
+                row.pos_cap = min(row.pos_cap + adv,
+                                  len(row.prompt) + row.max_new - 1)
+
+    def _chunk_kv_tokens(self, w: int, adv: int) -> int:
+        """Host-modeled cached tokens ONE forward pass reads through a
+        ``w``-page table when each row sits ``adv`` positions past its
+        pre-dispatch ``pos_cap`` (the forward's deepest query): the gather
+        path materializes every program row's full ``w`` pages regardless;
+        the Pallas kernel stops at each resident row's live depth,
+        ``ceil((pos_cap+adv)/pt)`` pages (empty program rows repeat one
+        clamped page — noise the model ignores). Callers sum one span per
+        forward (each chunk step / drafter iteration deepens ``adv``)."""
+        pt = self.page_tokens
+        if self.paged_attn != "pallas":
+            return self.slots * w * pt
+        total = 0
+        for row in self._slot_rows:
+            if row is None or row.lease is None:
+                continue
+            total += min(-(-(row.pos_cap + adv) // pt), w) * pt
+        return total
+
     def _dispatch_chunk_paged(self, size: int) -> tuple:
-        # the table ships as a COPY: jnp.asarray of a numpy array can be
-        # zero-copy on CPU, and the host mutates self._table in place the
-        # moment a row retires (often right after dispatching its dying
-        # chunk) — an aliased buffer would hand the still-executing program
-        # a zeroed table row and trash-redirect the row's final tokens
+        # the table ships CLAMPED to the batch's live width (see
+        # _live_table_width) and as a COPY: jnp.asarray of a numpy array
+        # can be zero-copy on CPU, and the host mutates self._table in
+        # place the moment a row retires (often right after dispatching
+        # its dying chunk) — an aliased buffer would hand the
+        # still-executing program a zeroed table row and trash-redirect
+        # the row's final tokens
+        w = self._live_table_width(size)
         self._slab, packed = self._steps[size](
-            self._variables, self._slab, jnp.asarray(self._table.copy()))
+            self._variables, self._slab,
+            jnp.asarray(self._table[:, :w].copy()))
+        # one span per step: step s's query sits s positions past pos_cap
+        kv_bytes = sum(self._chunk_kv_tokens(w, s)
+                       for s in range(1, size + 1)) * self._kv_token_bytes
+        self._bump_pos_caps(size)
         for row in self._slot_rows:
             if row is not None and not row.done and not row.canceled:
                 row.dispatched += size
         self.stats.chunk()
-        return ("chunk", packed, list(self._slot_rows))
+        return ("chunk", packed, list(self._slot_rows), kv_bytes)
 
     def _retire_dispatched(self) -> None:
         """Per-token admission's other half: a row whose every remaining
@@ -2137,6 +2329,10 @@ class PagedBatchingDecoder(BatchingDecoder):
     def telemetry(self) -> dict:
         snap = super().telemetry()
         snap.update(self._pool.telemetry())
+        # which arena read path this engine compiled (1 = Pallas kernel,
+        # 0 = gather fallback) — the bench scrape's ground truth
+        snap["paged_attn_kernel"] = (1.0 if self.paged_attn == "pallas"
+                                     else 0.0)
         if self._spec_ctl is not None:
             # current adaptive speculation depth (0 = retreated to plain
             # decode) + the controller's EWMA acceptance estimate

@@ -43,7 +43,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
-from ..ps.metrics import Histogram, OCCUPANCY_BUCKETS
+from ..ps.metrics import BANDWIDTH_BUCKETS, Histogram, OCCUPANCY_BUCKETS
 from ..utils.timeseries import Series
 
 # ring sizes: enough for stable p95 under load, bounded for a resident server
@@ -88,6 +88,13 @@ class DecoderStats:
         # prompt tokens those cached pages covered (prefill skipped them)
         self.prefix_hits = 0
         self.prefix_tokens_saved = 0
+        # KV-read accounting (ISSUE 15): bytes the decode-path attention
+        # read from the KV cache, host-modeled from the table geometry each
+        # dispatch shipped (gather = rows x gathered width, Pallas kernel =
+        # live pages only — the whole point of the paged-attention kernel
+        # is making this number scale with occupancy, and the counter is
+        # how the win shows on a scrape)
+        self.kv_read_bytes = 0
         # speculative decoding (paged engine spec mode): drafted = tokens
         # the drafter sampled, proposed = candidate emissions submitted to
         # one-pass verification (drafts + the bonus position per live row),
@@ -135,6 +142,9 @@ class DecoderStats:
         self._hist_slot_idle = Histogram()
         # per-chunk live-fraction distribution (0..1 edges)
         self._hist_occupancy = Histogram(OCCUPANCY_BUCKETS)
+        # achieved KV-read bandwidth per decode chunk (modeled bytes over
+        # the chunk's fetch wall — the execution barrier), log-scaled edges
+        self._hist_kv_bw = Histogram(BANDWIDTH_BUCKETS)
         # per-verify-step acceptance-ratio distribution (0..1 edges)
         self._hist_spec_accept = Histogram(OCCUPANCY_BUCKETS)
         # live gauges are read from the decoder at render time (queue depth,
@@ -191,6 +201,18 @@ class DecoderStats:
             self.spec_proposed_tokens += int(proposed)
             self._hist_spec_accept.observe(
                 min(1.0, int(accepted) / int(drafted)))
+
+    def kv_read(self, nbytes: int, seconds: float = 0.0) -> None:
+        """One dispatched program's modeled KV-cache read traffic:
+        ``nbytes`` accumulates the counter; with ``seconds`` (the decode
+        chunk's fetch wall time) the achieved-bandwidth histogram gets one
+        observation. Prefill programs report bytes only (seconds 0)."""
+        if nbytes <= 0:
+            return
+        with self._lock:
+            self.kv_read_bytes += int(nbytes)
+            if seconds > 0:
+                self._hist_kv_bw.observe(nbytes / seconds)
 
     def prefix_hit(self, tokens_saved: int) -> None:
         """One admission served partly from the shared-prefix cache:
@@ -345,6 +367,7 @@ class DecoderStats:
                 "wasted_tokens": float(self.wasted_tokens),
                 "prefix_hits": float(self.prefix_hits),
                 "prefix_tokens_saved": float(self.prefix_tokens_saved),
+                "kv_read_bytes": float(self.kv_read_bytes),
                 # lifetime useful fraction of raw device slot-step capacity
                 "goodput_ratio": (self.live_slot_steps / self.slot_steps
                                   if self.slot_steps else 0.0),
@@ -376,6 +399,7 @@ class DecoderStats:
                            ("decode_active", self._hist_decode_active),
                            ("slot_idle", self._hist_slot_idle),
                            ("occupancy_ratio", self._hist_occupancy),
+                           ("kv_bandwidth", self._hist_kv_bw),
                            ("spec_accept_ratio", self._hist_spec_accept)):
                 if h.count:
                     hist[key] = h.snapshot()

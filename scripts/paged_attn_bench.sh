@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Paged-attention decode microbench + regression gate (ISSUE 15).
+#
+# Measures one jitted L=1 paged decode step at several cached depths
+# through three read paths — gather-full (the pre-clamp baseline that
+# scales with the reserved TABLE WIDTH), gather-clamped (the engine's
+# live-width fallback) and the Pallas page-walk kernel (per-row live-page
+# reads; interpret mode off-TPU, where its wall time is a python-loop
+# artifact and the modeled kv_read_bytes column carries the traffic
+# story) — appending rows to results/paged_attn.jsonl, then gates
+# clamped-vs-full through scripts/bench_compare.py on the
+# paged_decode_step_ms (lower-is-better) metric: the optimization must
+# never make a decode step SLOWER than the baseline it replaces.
+#
+#   scripts/paged_attn_bench.sh [--seq-lens 32,128,448] [--reps N]
+#                               [--impls ...] [--serving]
+#
+# --serving additionally drives the long-workload paged serving row
+# (benchmarks/serving.py --long-workload --paged through a live cluster —
+# heavy; the serving_fraction_of_one_shot gate consumes it).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p results
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m kubeml_tpu.benchmarks.paged_attn_bench \
+    --out results/paged_attn.jsonl "$@"
+if [[ -f results/paged_attn_gate_baseline.json ]]; then
+    python scripts/bench_compare.py \
+        results/paged_attn_gate_baseline.json \
+        results/paged_attn_gate_candidate.json
+fi

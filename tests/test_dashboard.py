@@ -62,6 +62,7 @@ def _exported_names() -> set:
     stats.chunk_fetched(0.08, 8)
     stats.chunk_occupancy(8, 20, 6, 6)
     stats.admit_tokens(10, 22)
+    stats.kv_read(1 << 20, 0.01)
     stats.spec_step(drafted=8, accepted=6, proposed=10)
     stats.fetch_started()
     stats.fetch_finished(0.01)
@@ -71,7 +72,8 @@ def _exported_names() -> set:
     snap = stats.snapshot()
     snap.update({"queue_depth": 1.0, "slots_busy": 1.0, "slots_total": 4.0,
                  "slot_occupancy": 0.25, "weight_bytes": 1024.0,
-                 "queue_limit": 16.0, "spec_k": 4.0})
+                 "queue_limit": 16.0, "spec_k": 4.0,
+                 "paged_attn_kernel": 1.0})
     reg.set_serving_source(lambda: {"drift-model": snap})
     # SLO burn/state gauges
     reg.set_slo_source(lambda: {"burn": {("drift", "fast"): 0.5},
@@ -160,6 +162,17 @@ def test_spec_decode_panels_present():
                    "kubeml_serving_spec_drafted_tokens_total",
                    "kubeml_serving_spec_accept_ratio_bucket",
                    "kubeml_serving_spec_k"):
+        assert metric in refs, f"no panel charts {metric}"
+
+
+def test_paged_attention_kv_panel_present():
+    """The ISSUE-15 panel: KV-read byte rate, achieved-bandwidth p95 and
+    the kernel/gather gauge — the paged-attention traffic win must be
+    chartable."""
+    refs = _dashboard_names()
+    for metric in ("kubeml_serving_kv_read_bytes_total",
+                   "kubeml_serving_kv_bandwidth_bytes_per_sec_bucket",
+                   "kubeml_serving_paged_attn_pallas"):
         assert metric in refs, f"no panel charts {metric}"
 
 

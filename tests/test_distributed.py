@@ -185,7 +185,13 @@ def test_dashboard_metrics_exist_in_registry():
     # one speculative verify step so the acceptance-ratio histogram's
     # _bucket series renders (the spec acceptance panel queries it)
     stats.spec_step(drafted=8, accepted=6, proposed=10)
-    reg.set_serving_source(lambda: {"m": stats.snapshot()})
+    # one decode chunk's KV reads so the achieved-bandwidth histogram's
+    # _bucket series renders (the KV-read panel queries it); the
+    # paged_attn gauge rides the snapshot like the engine's telemetry
+    stats.kv_read(1 << 20, 0.01)
+    snap = stats.snapshot()
+    snap["paged_attn_kernel"] = 0.0
+    reg.set_serving_source(lambda: {"m": snap})
     # SLO burn/state gauges (the burn-rate and alert-state panels)
     reg.set_slo_source(lambda: {"burn": {("o", "fast"): 0.5},
                                 "state": {"o": 0}})
