@@ -336,6 +336,15 @@ class Config:
     # key — toggling can never serve a stale compiled program.
     paged_attn: str = field(
         default_factory=lambda: os.environ.get("KUBEML_PAGED_ATTN", "auto"))
+    # paged-arena STORAGE dtype (ops/paged_attention.resolve_kv_quant):
+    # "int8" stores K/V pages int8 with per-page-per-head scale arenas —
+    # the kernel dequantizes in VMEM, arena sizing re-derives the page
+    # count from the unquantized byte budget (~2x capacity at bf16, ~4x
+    # at f32), and kv_read_bytes accounting models the storage bytes.
+    # "off" (default) keeps the compute dtype; "auto" reserves TPU
+    # auto-enable for when on-device parity evidence lands (today: off).
+    kv_quant: str = field(
+        default_factory=lambda: os.environ.get("KUBEML_KV_QUANT", "off"))
     # --- speculative decoding (paged engine only; serving/batcher.py
     # spec mode + models/generation.py acceptance math) ---
     # drafter backend: "off" (default), "self" (early-exit logits from a
@@ -364,6 +373,16 @@ class Config:
     # 0 derives depth // 2
     spec_exit_layer: int = field(
         default_factory=lambda: _env_int("KUBEML_SPEC_EXIT_LAYER", 0))
+    # draft-backend acceptance floor (serving/spec.py): sustained EWMA
+    # acceptance below this permanently disables drafting for the served
+    # model (one warning + kubeml_serving_spec_disabled=1) — the draft
+    # backend cannot suspend/re-probe, so a mismatched checkpoint would
+    # otherwise pay a full drafter forward per step forever. 0 disables
+    # the guard. Applies to spec=draft only; spec=self retreats via the
+    # adaptive controller's suspend path instead.
+    spec_min_accept: float = field(
+        default_factory=lambda: float(
+            os.environ.get("KUBEML_SPEC_MIN_ACCEPT", "0.10")))
 
     def serving_mesh_axes(self) -> dict:
         """Parsed ``serving_mesh`` ({} when disabled); same ``ax=n`` comma

@@ -47,6 +47,12 @@ GATE_METRICS = {
     # decode read path — the live-width clamp / Pallas page-walk kernel
     # regress the gate if a candidate's step gets slower
     "paged_decode_step_ms": ("decode_step_ms", "lower"),
+    # int8 KV-page capacity win (paged_attn_bench --serving capacity row):
+    # tokens admitted under KUBEML_KV_QUANT=int8 over tokens admitted
+    # unquantized at the SAME arena byte budget. The gate baseline carries
+    # the ideal storage ratio (2.0 for bf16 arenas), so the 10% threshold
+    # holds the measured candidate to >= ~1.8x admitted tokens.
+    "kv_quant_capacity_ratio": ("kv_quant_capacity_ratio", "higher"),
 }
 
 

@@ -19,10 +19,20 @@ CausalTransformer through three read paths:
   the chip is where its wall-clock claim is settled; the modeled
   ``kv_read_bytes`` column carries the traffic story everywhere).
 
-Rows append to ``results/paged_attn.jsonl``; the gate pair
-(``paged_attn_gate_{baseline,candidate}.json``) feeds
+The clamped impls additionally measure ``KUBEML_KV_QUANT=int8`` storage
+(ISSUE 16): same step, quantized arenas — decode-step ms plus the modeled
+``kv_read_bytes`` column, which halves (bf16) / quarters (f32) because
+the accounting charges storage-dtype bytes. A host-only ``capacity`` row
+runs the real KVPool admission loop at one fixed arena byte budget,
+int8 vs compute-dtype storage; its ``kv_quant_capacity_ratio`` feeds the
+gate with an ideal-bf16 baseline of 2.0 (candidate must hold >= ~1.8x).
+
+Rows append to ``results/paged_attn.jsonl``; the gate pairs
+(``paged_attn_gate_{baseline,candidate}.json`` and
+``kv_quant_gate_{baseline,candidate}.json``) feed
 ``scripts/bench_compare.py`` via the ``paged_decode_step_ms``
-lower-is-better metric. ``--serving`` additionally runs the long-workload
+lower-is-better and ``kv_quant_capacity_ratio`` higher-is-better
+metrics. ``--serving`` additionally runs the long-workload
 paged serving row (benchmarks/serving.py --long-workload --paged) so the
 ``serving_fraction_of_one_shot`` gate tracks the end-to-end effect.
 
@@ -60,7 +70,8 @@ def _pow2(n: int, cap: int) -> int:
 
 
 def _prep_paged(module, variables, *, batch: int, seq_len: int, horizon: int,
-                page_tokens: int, impl: str, rng: np.random.Generator):
+                page_tokens: int, impl: str, rng: np.random.Generator,
+                kv_quant: str = "off"):
     """The shared setup BOTH bench stages use (so timing rows and the
     token-parity oracle can never measure different configurations): clone
     the read impl onto the module, build contiguous per-row tables at the
@@ -77,7 +88,7 @@ def _prep_paged(module, variables, *, batch: int, seq_len: int, horizon: int,
     table_pages = -(-cap // pt)
     paged_attn = "pallas" if impl == "pallas" else "gather"
     mod = module.clone(page_tokens=pt, kv_pages=batch * table_pages + 1,
-                       paged_attn=paged_attn)
+                       paged_attn=paged_attn, kv_quant=kv_quant)
     # contiguous per-row tables over the arena (page 0 stays the trash page)
     full = np.asarray(
         [[1 + r * table_pages + j for j in range(table_pages)]
@@ -100,15 +111,19 @@ def _prep_paged(module, variables, *, batch: int, seq_len: int, horizon: int,
 
 def measure_decode_step(module, variables, *, batch: int, seq_len: int,
                         page_tokens: int, impl: str, reps: int,
-                        rng: np.random.Generator) -> dict:
+                        rng: np.random.Generator,
+                        kv_quant: str = "off") -> dict:
     """One row: prefill ``batch`` rows to ``seq_len``, then time the jitted
-    single-token step through the requested read path / table width."""
+    single-token step through the requested read path / table width.
+    ``kv_quant="int8"`` measures the same step over quantized arenas —
+    the modeled ``kv_read_bytes`` column halves (bf16) / quarters (f32)
+    because ``_kv_token_bytes`` charges storage-dtype bytes."""
     from ..serving.batcher import _kv_token_bytes
 
     pt = int(page_tokens)
     mod, table, w, table_pages, cache, tok = _prep_paged(
         module, variables, batch=batch, seq_len=seq_len, horizon=reps + 1,
-        page_tokens=page_tokens, impl=impl, rng=rng)
+        page_tokens=page_tokens, impl=impl, rng=rng, kv_quant=kv_quant)
 
     @jax.jit
     def step(variables, cache, tok, pos, table):
@@ -134,6 +149,7 @@ def measure_decode_step(module, variables, *, batch: int, seq_len: int,
     return {
         "metric": "paged-attn-decode-step",
         "impl": impl,
+        "kv_quant": kv_quant,
         "batch": batch,
         "seq_len": seq_len,
         "max_len": int(module.max_len),
@@ -153,13 +169,16 @@ def measure_decode_step(module, variables, *, batch: int, seq_len: int,
 
 def greedy_chain(module, variables, *, batch: int, prompt_len: int,
                  steps: int, page_tokens: int, impl: str,
-                 rng: np.random.Generator) -> np.ndarray:
+                 rng: np.random.Generator,
+                 kv_quant: str = "off") -> np.ndarray:
     """[batch, steps+1] greedy tokens through one read path — the bench's
     own token-parity oracle (the acceptance gate asserts the three impls
-    emit identical chains before any timing row counts)."""
+    emit identical chains before any timing row counts; int8 storage is
+    held to exact kernel-vs-gather agreement plus a token-agreement
+    threshold against the unquantized chain)."""
     mod, table, _w, _tp, cache, tok = _prep_paged(
         module, variables, batch=batch, seq_len=prompt_len, horizon=steps,
-        page_tokens=page_tokens, impl=impl, rng=rng)
+        page_tokens=page_tokens, impl=impl, rng=rng, kv_quant=kv_quant)
     out = [np.asarray(tok)]
     for i in range(steps):
         logits, vs = mod.apply(
@@ -170,6 +189,50 @@ def greedy_chain(module, variables, *, batch: int, prompt_len: int,
         tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         out.append(np.asarray(tok))
     return np.stack(out, axis=1)
+
+
+def capacity_row(module, *, page_tokens: int, pages: int, prompt_len: int,
+                 max_new: int) -> dict:
+    """The int8 capacity row: tokens ADMITTED (real KVPool admission loop,
+    worst-case reservations, no device work) at one fixed arena byte
+    budget — the budget the unquantized arena of ``pages`` pages occupies
+    — under compute-dtype vs int8 storage. ``kv_quant_capacity_ratio``
+    is the bench_compare gate metric: the baseline gate file carries the
+    ideal bf16 storage ratio 2.0, so the 10% threshold holds the measured
+    ratio to >= ~1.8x."""
+    from ..serving.batcher import _kv_page_bytes
+    from ..serving.kvpool import KVPool
+
+    pt = int(page_tokens)
+    bytes_off = _kv_page_bytes(module, pt, "off")
+    bytes_q = _kv_page_bytes(module, pt, "int8")
+    budget = (int(pages) - 1) * bytes_off
+    npages = {"off": int(pages), "int8": int(budget // bytes_q) + 1}
+    admitted = {}
+    prompt = list(range(1, prompt_len + 1))
+    for tag, n in npages.items():
+        pool = KVPool(n, pt, prefix_cache=False)
+        count = 0
+        while pool.admit(prompt, max_new) is not None:
+            count += 1
+        # every admitted row may write prompt + max_new - 1 positions and
+        # returns max_new tokens — count the tokens the budget serves
+        admitted[tag] = count * (prompt_len + max_new)
+    ratio = admitted["int8"] / max(admitted["off"], 1)
+    return {
+        "metric": "paged-kv-capacity",
+        "page_tokens": pt,
+        "arena_bytes_budget": budget,
+        "pages_off": npages["off"],
+        "pages_int8": npages["int8"],
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new,
+        "tokens_admitted_off": admitted["off"],
+        "tokens_admitted_int8": admitted["int8"],
+        "kv_quant_capacity_ratio": round(ratio, 3),
+        "storage_itemsize": int(jnp.dtype(
+            getattr(module, "dtype", jnp.float32)).itemsize),
+    }
 
 
 def run(argv: Optional[List[str]] = None) -> int:
@@ -206,9 +269,10 @@ def run(argv: Optional[List[str]] = None) -> int:
     # files existing, and a run that doesn't measure both gather impls must
     # not let bench_compare pass on stale data it never produced
     for tag in ("baseline", "candidate"):
-        gp = out_path.parent / f"paged_attn_gate_{tag}.json"
-        if gp.exists():
-            gp.unlink()
+        for stem in ("paged_attn_gate", "kv_quant_gate"):
+            gp = out_path.parent / f"{stem}_{tag}.json"
+            if gp.exists():
+                gp.unlink()
     rows = []
     # token-parity gate first: every read path must emit the identical
     # greedy chain before its timings mean anything
@@ -228,19 +292,64 @@ def run(argv: Optional[List[str]] = None) -> int:
         with out_path.open("a") as f:
             f.write(json.dumps(parity_row) + "\n")
         raise SystemExit("FAIL: greedy token parity broken across impls")
+    # int8-storage oracle: the kernel and the gather read the SAME
+    # quantized arena, so their greedy chains must agree EXACTLY; against
+    # the unquantized reference the storage rounding may flip near-ties,
+    # so that comparison is a token-agreement RATE with a floor
+    int8_impls = [i for i in impls if i in ("gather-clamped", "pallas")]
+    if int8_impls:
+        q_chains = {impl: greedy_chain(
+            module, variables, batch=args.batch, prompt_len=16, steps=8,
+            page_tokens=args.page_tokens, impl=impl,
+            rng=np.random.default_rng(1), kv_quant="int8")
+            for impl in int8_impls}
+        q_ref = q_chains[int8_impls[0]]
+        q_parity = all(np.array_equal(q_ref, q_chains[i])
+                       for i in int8_impls)
+        agree = float(np.mean(q_ref == chains[ref_impl]))
+        q_row = {"metric": "paged-attn-int8-token-agreement",
+                 "impls": int8_impls, "kernel_vs_gather_exact": bool(q_parity),
+                 "agreement_vs_unquantized": round(agree, 4),
+                 "agreement_floor": 0.9,
+                 "pass": bool(q_parity and agree >= 0.9),
+                 "backend": jax.default_backend()}
+        print(json.dumps(q_row), flush=True)
+        rows.append(q_row)
+        if not q_row["pass"]:
+            with out_path.open("a") as f:
+                f.write(json.dumps(q_row) + "\n")
+            raise SystemExit("FAIL: int8 KV-page token agreement broken")
     for impl in impls:
-        for seq in seq_lens:
-            if seq + 2 + args.reps > args.max_len:
-                raise SystemExit(f"seq_len {seq} + steps exceeds max_len")
-            row = measure_decode_step(
-                module, variables, batch=args.batch, seq_len=seq,
-                page_tokens=args.page_tokens, impl=impl, reps=args.reps,
-                rng=rng)
-            rows.append(row)
-            print(json.dumps(row), flush=True)
+        quants = [("off",)] + ([("int8",)] if impl in int8_impls else [])
+        for (kvq,) in quants:
+            for seq in seq_lens:
+                if seq + 2 + args.reps > args.max_len:
+                    raise SystemExit(f"seq_len {seq} + steps exceeds max_len")
+                row = measure_decode_step(
+                    module, variables, batch=args.batch, seq_len=seq,
+                    page_tokens=args.page_tokens, impl=impl, reps=args.reps,
+                    rng=rng, kv_quant=kvq)
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+    # the capacity row is host-only allocator math — always emitted
+    cap_row = capacity_row(module, page_tokens=args.page_tokens,
+                           pages=args.batch * (-(-args.max_len
+                                                 // args.page_tokens)) + 1,
+                           prompt_len=64, max_new=64)
+    cap_row["backend"] = jax.default_backend()
+    rows.append(cap_row)
+    print(json.dumps(cap_row), flush=True)
     with out_path.open("a") as f:
         for row in rows:
             f.write(json.dumps(row) + "\n")
+    # kv-quant gate pair: the baseline carries the IDEAL bf16 storage
+    # ratio (2.0) so bench_compare's 10% threshold enforces the measured
+    # candidate ratio >= ~1.8x admitted tokens at the same byte budget
+    kvq_base = {"metric": "paged-kv-capacity",
+                "kv_quant_capacity_ratio": 2.0, "ideal": True}
+    for tag, row in (("baseline", kvq_base), ("candidate", cap_row)):
+        gp = out_path.parent / f"kv_quant_gate_{tag}.json"
+        gp.write_text(json.dumps(row))
 
     # --- the bench_compare gate pair: candidate = the engine's actual
     # fallback configuration (clamped gather), baseline = the pre-clamp

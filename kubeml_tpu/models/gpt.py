@@ -68,6 +68,12 @@ class CausalSelfAttention(nn.Module):
     # kernel (ops/paged_attention.py — KV traffic scales with occupancy,
     # no contiguous copy); "auto" = pallas on TPU, gather elsewhere
     paged_attn: str = "auto"
+    # paged-arena STORAGE dtype (KUBEML_KV_QUANT): "off" keeps the compute
+    # dtype; "int8" stores pages int8 with per-page-per-head running-absmax
+    # scale arenas [kv_pages, H] (k_scale/v_scale) — the write scatter
+    # quantizes, both read paths dequantize, and the same arena byte budget
+    # holds 2-4x the tokens (ops/paged_attention.resolve_kv_quant)
+    kv_quant: str = "off"
 
     @nn.compact
     def __call__(self, x, valid, decode: bool = False, positions=None,
@@ -137,10 +143,24 @@ class CausalSelfAttention(nn.Module):
                     raise ValueError("paged decode needs per-row positions")
                 pt, npg = self.page_tokens, self.kv_pages
                 tw = pages.shape[1]  # table width (logical pages per row)
+                from ..ops.paged_attention import resolve_kv_quant
+
+                kvq = resolve_kv_quant(self.kv_quant)
+                store_dtype = jnp.int8 if kvq == "int8" else k.dtype
                 ck = self.variable("cache", "k_pages", jnp.zeros,
-                                   (npg, pt, H, D), k.dtype)
+                                   (npg, pt, H, D), store_dtype)
                 cv = self.variable("cache", "v_pages", jnp.zeros,
-                                   (npg, pt, H, D), v.dtype)
+                                   (npg, pt, H, D), store_dtype)
+                if kvq == "int8":
+                    # per-page-per-head running absmax: a page's int8 value
+                    # q reconstructs as q * scale / 127. Scales live in the
+                    # same cache collection and are addressed by PHYSICAL
+                    # page, so shared prefix pages carry their scales with
+                    # them — trie reuse stays free.
+                    ks = self.variable("cache", "k_scale", jnp.zeros,
+                                       (npg, H), jnp.float32)
+                    vs = self.variable("cache", "v_scale", jnp.zeros,
+                                       (npg, H), jnp.float32)
                 pos_full = positions[:, None] + jnp.arange(L)  # [B, L]
                 if self.rope:
                     from ..ops.rotary import apply_rope
@@ -161,22 +181,78 @@ class CausalSelfAttention(nn.Module):
                 phys = jnp.take_along_axis(pages, page_idx, axis=1)  # [B, L]
                 phys = jnp.where(wvalid, phys, 0)
                 off = pos_full % pt
-                ck.value = ck.value.at[phys, off].set(k)
-                cv.value = cv.value.at[phys, off].set(v)
+                if kvq == "int8":
+                    # quantized scatter write, three moves riding the same
+                    # (phys, off) coordinates: (1) scatter-max the new
+                    # tokens' per-head absmax into the touched pages'
+                    # scales (monotone — a spec-rollback's rejected drafts
+                    # leave only a bounded precision loss, never a leak);
+                    # (2) requantize the touched pages' EXISTING rows for
+                    # the scale growth (duplicate page gathers all derive
+                    # identical bytes from the old arena + final scale, so
+                    # the duplicate scatter writes agree); (3) quantize and
+                    # scatter this call's K/V at the final scale. Trash
+                    # page 0 takes redirected writes exactly as before —
+                    # its scale grows with the garbage, and nothing reads
+                    # it meaningfully.
+                    def _quant_write(arena, scales, x):
+                        xf = x.astype(jnp.float32)
+                        amax = jnp.abs(xf).max(axis=-1)          # [B, L, H]
+                        new_s = scales.at[phys].max(amax)        # [npg, H]
+                        old_at = scales[phys]                    # [B, L, H]
+                        new_at = new_s[phys]                     # [B, L, H]
+                        ratio = jnp.where(new_at > 0.0,
+                                          old_at / jnp.maximum(new_at, 1e-30),
+                                          1.0)
+                        old_q = arena[phys].astype(jnp.float32)  # [B,L,pt,H,D]
+                        req = jnp.clip(
+                            jnp.round(old_q * ratio[:, :, None, :, None]),
+                            -127, 127).astype(jnp.int8)
+                        arena = arena.at[phys].set(req)
+                        qv = jnp.clip(
+                            jnp.round(xf * 127.0
+                                      / jnp.maximum(new_at, 1e-30)[..., None]),
+                            -127, 127).astype(jnp.int8)
+                        return arena.at[phys, off].set(qv), new_s
+
+                    ck.value, ks.value = _quant_write(ck.value, ks.value, k)
+                    cv.value, vs.value = _quant_write(cv.value, vs.value, v)
+                else:
+                    ck.value = ck.value.at[phys, off].set(k)
+                    cv.value = cv.value.at[phys, off].set(v)
                 from ..ops.paged_attention import resolve_paged_attn
 
                 if resolve_paged_attn(self.paged_attn) == "pallas":
                     # stream pages through VMEM with the online-softmax
                     # kernel: the arena gather happens per block inside
                     # the kernel's DMA walk and reads stop at each row's
-                    # live depth — no [B, tw*pt, H, D] copy in HBM
+                    # live depth — no [B, tw*pt, H, D] copy in HBM. In
+                    # int8 mode the per-page scales ride the same page
+                    # walk and dequant happens inside the kernel blocks.
                     from ..ops.paged_attention import paged_attention
 
-                    out = paged_attention(q, ck.value, cv.value, pages,
-                                          positions)
+                    if kvq == "int8":
+                        out = paged_attention(q, ck.value, cv.value, pages,
+                                              positions, k_scale=ks.value,
+                                              v_scale=vs.value)
+                    else:
+                        out = paged_attention(q, ck.value, cv.value, pages,
+                                              positions)
                 else:
-                    kg = ck.value[pages].reshape(B, tw * pt, H, D)
-                    vg = cv.value[pages].reshape(B, tw * pt, H, D)
+                    kg = ck.value[pages]  # [B, tw, pt, H, D]
+                    vg = cv.value[pages]
+                    if kvq == "int8":
+                        # gather-path dequant: the parity oracle for the
+                        # quantized STORAGE format itself (same q*s/127
+                        # reconstruction as the kernel's VMEM dequant)
+                        kg = (kg.astype(jnp.float32)
+                              * (ks.value[pages] / 127.0)[:, :, None, :, None]
+                              ).astype(q.dtype)
+                        vg = (vg.astype(jnp.float32)
+                              * (vs.value[pages] / 127.0)[:, :, None, :, None]
+                              ).astype(q.dtype)
+                    kg = kg.reshape(B, tw * pt, H, D)
+                    vg = vg.reshape(B, tw * pt, H, D)
                     k_pos = jnp.arange(tw * pt)[None, None, None, :]
                     # [B, 1, L, tw*pt]
                     mask = k_pos <= pos_full[:, None, :, None]
@@ -292,6 +368,7 @@ class GPTBlock(nn.Module):
     page_tokens: int = 0
     kv_pages: int = 0
     paged_attn: str = "auto"
+    kv_quant: str = "off"
 
     @nn.compact
     def __call__(self, x, valid, train: bool = False, decode: bool = False,
@@ -306,6 +383,7 @@ class GPTBlock(nn.Module):
                                 page_tokens=self.page_tokens,
                                 kv_pages=self.kv_pages,
                                 paged_attn=self.paged_attn,
+                                kv_quant=self.kv_quant,
                                 name="attn")(y, valid, decode=decode,
                                              positions=positions,
                                              pages=pages, seq_lens=seq_lens)
@@ -369,10 +447,14 @@ class CausalTransformer(nn.Module):
     # the shared arena). 0/0 keeps the dense per-row cache. ``paged_attn``
     # picks the arena READ path: "pallas" streams pages through the
     # ops/paged_attention.py kernel, "gather" materializes the table as a
-    # contiguous block (parity oracle), "auto" = pallas on TPU only. ---
+    # contiguous block (parity oracle), "auto" = pallas on TPU only.
+    # ``kv_quant`` picks the arena STORAGE dtype: "int8" quantizes pages
+    # with per-page-per-head scale arenas so the same byte budget holds
+    # 2-4x the tokens; "off" (default) stores the compute dtype. ---
     page_tokens: int = 0
     kv_pages: int = 0
     paged_attn: str = "auto"
+    kv_quant: str = "off"
 
     @nn.compact
     def __call__(self, token_ids, train: bool = False, decode: bool = False,
@@ -485,6 +567,7 @@ class CausalTransformer(nn.Module):
                                   page_tokens=self.page_tokens,
                                   kv_pages=self.kv_pages,
                                   paged_attn=self.paged_attn,
+                                  kv_quant=self.kv_quant,
                                   name=f"block_{i}")
                 # positions only exists on the decode path, which never remats
                 # — keeping the training call positional preserves the remat

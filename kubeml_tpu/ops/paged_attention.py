@@ -64,6 +64,31 @@ _LANES = 128
 
 VALID_IMPLS = ("auto", "pallas", "gather")
 
+VALID_KV_QUANT = ("off", "int8", "auto")
+
+# dequant convention shared with the write path in models/gpt.py: an int8
+# page value q reconstructs as q * scale / 127 where scale is the page's
+# per-head running absmax (so q = round(x * 127 / scale) saturates at +-127)
+_KV_QMAX = 127.0
+
+
+def resolve_kv_quant(value: Optional[str]) -> str:
+    """Resolve a ``KUBEML_KV_QUANT`` value to a concrete storage mode:
+    ``off`` (default) keeps the arenas in the compute dtype; ``int8``
+    stores pages int8 with per-page-per-head scale arenas (half/quarter
+    the KV bytes, bounded-divergence numerics); ``auto`` currently
+    resolves to ``off`` everywhere — it is reserved to enable int8 on
+    TPU once on-device parity evidence lands (mirrors the
+    resolve_paged_attn auto contract)."""
+    v = (value or "off").lower()
+    if v not in VALID_KV_QUANT:
+        raise ValueError(
+            f"unknown kv-quant mode {value!r} (valid: "
+            f"{', '.join(VALID_KV_QUANT)})")
+    if v == "auto":
+        return "off"
+    return v
+
 
 def resolve_paged_attn(value: Optional[str]) -> str:
     """Resolve a ``KUBEML_PAGED_ATTN`` value to a concrete implementation:
@@ -85,12 +110,22 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _pa_kernel(pages_ref, pos_ref, live_ref, q_ref, k_ref, v_ref, o_ref,
-               acc_ref, m_ref, l_ref, *, page_tokens: int, n_pages: int,
-               scale: float):
+def _pa_kernel(pages_ref, pos_ref, live_ref, q_ref, k_ref, v_ref, *rest,
+               page_tokens: int, n_pages: int, scale: float,
+               quantized: bool):
     """One (batch row, head, logical page) program. The page axis is the
     innermost (sequential) grid dimension; acc/m/l carry across it in VMEM
-    scratch, and the output is written at the final page step."""
+    scratch, and the output is written at the final page step.
+
+    When ``quantized`` the K/V blocks arrive int8 with their page's
+    per-head absmax scales as two extra ``(1, 1)`` inputs riding the same
+    clamped index map; dequant happens here in VMEM, int8_matmul-style —
+    contract the raw int8 values (cast is exact, |q| <= 127), then fold
+    the per-block scalar ``s/127`` into the f32 result after the matmul."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
     b = pl.program_id(0)
     i = pl.program_id(2)
     lq = q_ref.shape[2]
@@ -109,9 +144,13 @@ def _pa_kernel(pages_ref, pos_ref, live_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0, 0]           # [Lq, D] (storage dtype; f32 accumulate)
         k_pg = k_ref[0, :, 0, :]  # [pt, D] — one physical page, this head
         v_pg = v_ref[0, :, 0, :]
+        if quantized:
+            k_pg = k_pg.astype(q.dtype)
         s = jax.lax.dot_general(
             q, k_pg, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [Lq, pt]
+        if quantized:
+            s = s * (ks_ref[0, 0] / _KV_QMAX)
         # purely positional mask, identical to the gather path: query l sits
         # at logical position positions[b] + l and attends every key at or
         # before it (prompts are dense, decode writes contiguous — every
@@ -127,9 +166,16 @@ def _pa_kernel(pages_ref, pos_ref, live_ref, q_ref, k_ref, v_ref, o_ref,
         p = jnp.exp(s - m_new)
         p = jnp.where(s <= _NEG / 2, 0.0, p)  # masked keys stay exactly 0
         l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v_pg.dtype), v_pg, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        if quantized:
+            # contract p against the raw int8 page, fold the scale after
+            pv = jax.lax.dot_general(
+                p, v_pg.astype(p.dtype), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            pv = pv * (vs_ref[0, 0] / _KV_QMAX)
+        else:
+            pv = jax.lax.dot_general(
+                p.astype(v_pg.dtype), v_pg, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
         acc_ref[...] = acc_ref[...] * alpha + pv
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -147,6 +193,8 @@ def paged_attention(
     pages: jnp.ndarray,     # [B, P] int32 per-row page table
     positions: jnp.ndarray,  # [B] int32 logical position of q[:, 0]
     interpret: Optional[bool] = None,
+    k_scale: Optional[jnp.ndarray] = None,  # [N, H] f32 per-page absmax (int8)
+    v_scale: Optional[jnp.ndarray] = None,  # [N, H] f32 per-page absmax (int8)
 ) -> jnp.ndarray:
     """Paged decode attention; returns ``[B, L, H, D]``.
 
@@ -155,7 +203,12 @@ def paged_attention(
     attending under the positional causal mask — without the gather: the
     kernel walks each row's table page by page. Callers must have already
     scattered this call's K/V into the arenas (the paged decode branch in
-    models/gpt.py writes first, then attends)."""
+    models/gpt.py writes first, then attends).
+
+    With ``k_scale``/``v_scale`` the arenas are int8 (KUBEML_KV_QUANT=int8)
+    and each page's per-head absmax rides the same clamped page-walk index
+    map as its K/V block; dequant happens in the kernel's VMEM blocks
+    before the QK^T/PV matmuls — the arenas are never materialized wide."""
     B, L, H, D = q.shape
     pt = int(k_pages.shape[1])
     P = int(pages.shape[1])
@@ -176,6 +229,9 @@ def paged_attention(
     # output is discarded, matching the gather path's clip).
     live = jnp.clip((positions + L + pt - 1) // pt, 1, P)
     scale = 1.0 / math.sqrt(D)
+    quantized = k_scale is not None
+    if quantized and v_scale is None:
+        raise ValueError("k_scale and v_scale must be passed together")
 
     def q_map(b, h, i, pages_ref, pos_ref, live_ref):
         return (b, h, 0, 0)
@@ -188,14 +244,26 @@ def paged_attention(
         pg = jnp.maximum(jnp.minimum(i, live_ref[b] - 1), 0)
         return (pages_ref[b, pg], 0, h, 0)
 
+    def scale_map(b, h, i, pages_ref, pos_ref, live_ref):
+        # the page's [N, H] absmax rides the same clamped page walk
+        pg = jnp.maximum(jnp.minimum(i, live_ref[b] - 1), 0)
+        return (pages_ref[b, pg], h)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, lqp, D), q_map),
+        pl.BlockSpec((1, pt, 1, D), kv_map),
+        pl.BlockSpec((1, pt, 1, D), kv_map),
+    ]
+    operands = [qt, k_pages, v_pages]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, 1), scale_map),
+                     pl.BlockSpec((1, 1), scale_map)]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,  # pages, positions, live
         grid=(B, H, P),
-        in_specs=[
-            pl.BlockSpec((1, 1, lqp, D), q_map),
-            pl.BlockSpec((1, pt, 1, D), kv_map),
-            pl.BlockSpec((1, pt, 1, D), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, lqp, D), q_map),
         scratch_shapes=[
             pltpu.VMEM((lqp, D), jnp.float32),       # acc
@@ -204,9 +272,10 @@ def paged_attention(
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_pa_kernel, page_tokens=pt, n_pages=P, scale=scale),
+        functools.partial(_pa_kernel, page_tokens=pt, n_pages=P, scale=scale,
+                          quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, lqp, D), q.dtype),
         interpret=interpret,
-    )(pages, positions, live, qt, k_pages, v_pages)
+    )(pages, positions, live, *operands)
     return jnp.moveaxis(out[:, :, :L], 1, 2)

@@ -365,6 +365,10 @@ SERVING_GAUGES = {
                              "Pallas paged-attention kernel "
                              "(KUBEML_PAGED_ATTN), 0 on the gather "
                              "fallback"),
+    "kubeml_serving_kv_quant": (
+        "kv_quant", "1 when KV-cache pages are stored int8 with per-page "
+                    "scale arenas (KUBEML_KV_QUANT), 0 for compute-dtype "
+                    "storage"),
     # speculative decoding (spec-mode decoders only)
     "kubeml_serving_spec_accept_rate": (
         "spec_accept_rate", "Lifetime speculative acceptance rate "
@@ -372,6 +376,10 @@ SERVING_GAUGES = {
     "kubeml_serving_spec_k": (
         "spec_k", "Current adaptive speculation depth (0 = retreated to "
                   "plain decode pending a re-probe)"),
+    "kubeml_serving_spec_disabled": (
+        "spec_disabled", "1 once the draft backend's sustained acceptance "
+                         "fell below KUBEML_SPEC_MIN_ACCEPT and drafting "
+                         "was permanently disabled for this model"),
 }
 
 

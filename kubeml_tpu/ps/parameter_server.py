@@ -1347,7 +1347,9 @@ class ParameterServer:
             paged_kw = dict(page_tokens=self.cfg.serving_page_tokens,
                             pages=self.cfg.serving_pages,
                             prefix_cache=self.cfg.serving_prefix_cache,
-                            paged_attn=self.cfg.paged_attn)
+                            paged_attn=self.cfg.paged_attn,
+                            kv_quant=self.cfg.kv_quant,
+                            spec_min_accept=self.cfg.spec_min_accept)
             spec_kw = self._spec_decoder_args(module)
             try:
                 decoder = PagedBatchingDecoder(module, variables,

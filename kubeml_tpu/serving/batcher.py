@@ -331,7 +331,37 @@ def _kv_token_bytes(module, layers: Optional[int] = None) -> int:
     if not depth or not heads or not embed:
         return 0
     itemsize = jnp.dtype(getattr(module, "dtype", jnp.float32)).itemsize
+    # the accounting models STORAGE bytes: an int8-quantized arena
+    # (KUBEML_KV_QUANT, the module carries the resolved mode as a clone
+    # field) reads one byte per cached element — the halving/quartering
+    # must be visible on kubeml_serving_kv_read_bytes_total per caller.
+    # The per-page scale reads (heads x 4B per page per layer) are noise
+    # against page_tokens x embed element reads and stay unmodeled.
+    from ..ops.paged_attention import resolve_kv_quant
+
+    if resolve_kv_quant(getattr(module, "kv_quant", "off")) == "int8":
+        itemsize = 1
     return int(depth) * 2 * int(embed) * int(itemsize)
+
+
+def _kv_page_bytes(module, page_tokens: int, kv_quant: str = "off") -> int:
+    """HBM bytes ONE physical page occupies across every layer's K and V
+    arenas — the unit of the arena byte budget. int8 mode adds the page's
+    per-head f32 scale rows (k_scale/v_scale, [kv_pages, H]) so the
+    capacity derivation charges quantization's real overhead. 0 when the
+    module doesn't expose the transformer geometry."""
+    import jax.numpy as jnp
+
+    depth = getattr(module, "depth", None)
+    heads = getattr(module, "num_heads", None)
+    embed = getattr(module, "embed_dim", None)
+    if not depth or not heads or not embed:
+        return 0
+    if kv_quant == "int8":
+        return int(depth) * 2 * (int(page_tokens) * int(embed) * 1
+                                 + int(heads) * 4)
+    itemsize = jnp.dtype(getattr(module, "dtype", jnp.float32)).itemsize
+    return int(depth) * 2 * int(page_tokens) * int(embed) * int(itemsize)
 
 
 class _FetchPool:
@@ -1636,7 +1666,9 @@ class PagedBatchingDecoder(BatchingDecoder):
                  spec_adaptive: Optional[bool] = None,
                  draft_module=None, draft_variables=None,
                  spec_exit_layer: Optional[int] = None,
-                 paged_attn: Optional[str] = None, **kw):
+                 paged_attn: Optional[str] = None,
+                 kv_quant: Optional[str] = None,
+                 spec_min_accept: Optional[float] = None, **kw):
         if mesh is not None:
             raise ValueError(
                 "paged serving does not run on a mesh yet; use the dense "
@@ -1671,6 +1703,25 @@ class PagedBatchingDecoder(BatchingDecoder):
             # never admission-regresses vs slot mode; size it DOWN via
             # KUBEML_SERVING_PAGES for the memory win
             npages = slots * self.table_pages + 1
+        # --- KV-cache storage quantization (KUBEML_KV_QUANT=off|int8,
+        # ops/paged_attention.resolve_kv_quant): arena sizing derives the
+        # page count FROM THE BYTE BUDGET the unquantized arena would
+        # occupy, so int8 mode yields ~2x (bf16) / ~4x (f32) the pages at
+        # the same HBM spend — capacity, not memory, is the win surfaced.
+        # Modules predating the kv_quant clone field stay unquantized.
+        from ..ops.paged_attention import resolve_kv_quant
+
+        kvq = resolve_kv_quant(kv_quant if kv_quant is not None
+                               else cfg.kv_quant)
+        if not hasattr(module, "kv_quant"):
+            kvq = "off"
+        self.kv_quant = kvq
+        if kvq == "int8":
+            bytes_off = _kv_page_bytes(module, pt, "off")
+            bytes_q = _kv_page_bytes(module, pt, "int8")
+            if bytes_off and bytes_q:
+                budget = (npages - 1) * bytes_off
+                npages = max(npages, budget // bytes_q + 1)
         use_trie = bool(prefix_cache if prefix_cache is not None
                         else cfg.serving_prefix_cache)
         self._pool = KVPool(npages, pt, prefix_cache=use_trie)
@@ -1718,9 +1769,13 @@ class PagedBatchingDecoder(BatchingDecoder):
                     f"the target's ({cap})")
             # the drafter addresses THE SAME page ids through its own
             # arena, so shared-prefix pages carry valid draft K/V too
-            # (and reads it through the same attention impl)
+            # (and reads it through the same attention impl + storage mode
+            # — the doubled page count must not double the draft arena's
+            # bytes)
             dkw = ({"paged_attn": impl}
                    if hasattr(draft_module, "paged_attn") else {})
+            if hasattr(draft_module, "kv_quant"):
+                dkw["kv_quant"] = kvq
             self.draft_module = draft_module.clone(page_tokens=pt,
                                                    kv_pages=npages, **dkw)
         elif spec == "self":
@@ -1735,12 +1790,20 @@ class PagedBatchingDecoder(BatchingDecoder):
 
         # the draft backend never suspends (its KV cache is only coherent
         # while the drafter sees every decoded token); self-drafting may
-        # retreat to plain decode and re-probe
+        # retreat to plain decode and re-probe. A DRAFT backend whose
+        # sustained acceptance sits below KUBEML_SPEC_MIN_ACCEPT instead
+        # disables permanently (spec.py) — a mismatched draft checkpoint
+        # degrades to plain decode, not a latent throughput regression.
+        min_acc = float(spec_min_accept if spec_min_accept is not None
+                        else cfg.spec_min_accept)
         self._spec_ctl = (AdaptiveK(
             k_cap,
             adaptive=bool(spec_adaptive if spec_adaptive is not None
                           else cfg.spec_adaptive),
-            allow_off=(spec == "self")) if spec else None)
+            allow_off=(spec == "self"),
+            min_accept=(min_acc if spec == "draft" else 0.0))
+            if spec else None)
+        self._spec_disabled_logged = False
         # worst-case page reservation must cover the verify lookahead: a
         # spec step writes up to k positions past the row's final token
         # before the host learns they were rejected (admission math below)
@@ -1750,6 +1813,8 @@ class PagedBatchingDecoder(BatchingDecoder):
         clone_kw = dict(page_tokens=pt, kv_pages=npages)
         if hasattr(module, "paged_attn"):
             clone_kw["paged_attn"] = impl
+        if hasattr(module, "kv_quant"):
+            clone_kw["kv_quant"] = kvq
         module = module.clone(**clone_kw)
         super().__init__(module, variables, mesh=None, **kw)
         # drafter KV-read constant for the spec accounting: the early-exit
@@ -2054,6 +2119,14 @@ class PagedBatchingDecoder(BatchingDecoder):
         self.stats.spec_step(d_sum, a_sum, d_sum + live_rows)
         if self._spec_ctl is not None:
             self._spec_ctl.on_step(d_sum, a_sum)
+            if self._spec_ctl.disabled and not self._spec_disabled_logged:
+                self._spec_disabled_logged = True
+                log.warning(
+                    "%s: draft speculation disabled — sustained acceptance "
+                    "%.3f below KUBEML_SPEC_MIN_ACCEPT=%.3f; decoding "
+                    "continues plain (kubeml_serving_spec_disabled=1)",
+                    self.name, self._spec_ctl.ratio,
+                    self._spec_ctl.min_accept)
         for slot, row in enumerate(snapshot):
             if row is None or drafted[slot] <= 0:
                 continue
@@ -2333,12 +2406,18 @@ class PagedBatchingDecoder(BatchingDecoder):
         # 0 = gather fallback) — the bench scrape's ground truth
         snap["paged_attn_kernel"] = (1.0 if self.paged_attn == "pallas"
                                      else 0.0)
+        # arena storage mode (1 = int8-quantized pages, 0 = compute dtype)
+        # — pairs with pages_total so the capacity doubling is chartable
+        snap["kv_quant"] = 1.0 if self.kv_quant == "int8" else 0.0
         if self._spec_ctl is not None:
             # current adaptive speculation depth (0 = retreated to plain
             # decode) + the controller's EWMA acceptance estimate
             snap["spec_k"] = float(self._spec_ctl.current())
             if self._spec_ctl.ratio >= 0:
                 snap["spec_accept_ewma"] = float(self._spec_ctl.ratio)
+            # 1 = the draft-mode acceptance floor tripped and drafting is
+            # permanently off for this model (KUBEML_SPEC_MIN_ACCEPT)
+            snap["spec_disabled"] = 1.0 if self._spec_ctl.disabled else 0.0
         return snap
 
     # --- the engine loop (paged flavor) ---

@@ -18,7 +18,16 @@ models well) is rediscovered.
 
 The draft-model backend never suspends (``allow_off=False``): its
 separate KV cache is only coherent while the drafter sees every decoded
-token, and plain chunks would starve it — k floors at 1 instead.
+token, and plain chunks would starve it — k floors at 1 instead. That
+floor is also its failure mode: a mismatched draft checkpoint
+(results/spec_decode.jsonl measured acceptance 0.003-0.25) pins k=1 and
+pays a full drafter forward per step forever. ``min_accept`` is the
+retreat for THAT backend — sustained EWMA acceptance below the floor
+after the warm-up cooldown **permanently disables** drafting
+(``current() == 0``, no re-probe: the checkpoint will not get better),
+so enabling ``draft`` on the wrong model degrades to plain decode
+instead of a latent regression. The engine logs one warning and exports
+``kubeml_serving_spec_disabled`` on the transition.
 """
 
 from __future__ import annotations
@@ -48,9 +57,12 @@ class AdaptiveK:
     def __init__(self, k_max: int, *, adaptive: bool = True,
                  allow_off: bool = True, low: float = LOW,
                  high: float = HIGH, ewma: float = 0.2,
-                 cooldown: int = 8, probe_every: int = 64):
+                 cooldown: int = 8, probe_every: int = 64,
+                 min_accept: float = 0.0):
         if k_max < 1:
             raise ValueError("k_max must be >= 1")
+        if not (0.0 <= min_accept < 1.0):
+            raise ValueError("min_accept must be in [0, 1)")
         ladder = []
         t = 1
         while t < k_max:
@@ -65,17 +77,25 @@ class AdaptiveK:
         self.alpha = float(ewma)
         self.cooldown = int(cooldown)
         self.probe_every = int(probe_every)
+        self.min_accept = float(min_accept)
         self._idx = len(ladder) - 1  # start at the configured cap
         self._suspended = False
         self._ratio: float = -1.0    # EWMA; <0 = no sample yet
         self._since_move = 0
+        self._steps_seen = 0
         self._plain_chunks = 0
         # telemetry (engine snapshots these)
         self.moves = 0
         self.suspensions = 0
+        # the draft-mode acceptance-floor kill switch: once tripped it
+        # never re-arms (suspension re-probes; this does not)
+        self.disabled = False
 
     def current(self) -> int:
-        """The k the next spec dispatch should use; 0 = suspended."""
+        """The k the next spec dispatch should use; 0 = suspended or
+        permanently disabled (the min_accept floor tripped)."""
+        if self.disabled:
+            return 0
         return 0 if self._suspended else self.ladder[self._idx]
 
     @property
@@ -90,6 +110,15 @@ class AdaptiveK:
         r = accepted / drafted
         self._ratio = (r if self._ratio < 0
                        else self.alpha * r + (1 - self.alpha) * self._ratio)
+        self._steps_seen += 1
+        # the acceptance floor fires regardless of ``adaptive``: it guards
+        # a broken configuration, not a workload phase. The cooldown worth
+        # of samples lets the EWMA settle before judging.
+        if (self.min_accept > 0.0 and not self.disabled
+                and self._steps_seen >= self.cooldown
+                and self._ratio < self.min_accept):
+            self.disabled = True
+            return
         if not self.adaptive:
             return
         self._since_move += 1

@@ -73,7 +73,8 @@ def _exported_names() -> set:
     snap.update({"queue_depth": 1.0, "slots_busy": 1.0, "slots_total": 4.0,
                  "slot_occupancy": 0.25, "weight_bytes": 1024.0,
                  "queue_limit": 16.0, "spec_k": 4.0,
-                 "paged_attn_kernel": 1.0})
+                 "paged_attn_kernel": 1.0, "kv_quant": 1.0,
+                 "spec_disabled": 0.0})
     reg.set_serving_source(lambda: {"drift-model": snap})
     # SLO burn/state gauges
     reg.set_slo_source(lambda: {"burn": {("drift", "fast"): 0.5},
@@ -174,6 +175,17 @@ def test_paged_attention_kv_panel_present():
                    "kubeml_serving_kv_bandwidth_bytes_per_sec_bucket",
                    "kubeml_serving_paged_attn_pallas"):
         assert metric in refs, f"no panel charts {metric}"
+
+
+def test_kv_quant_and_spec_disabled_panels_present():
+    """The ISSUE-16 panels: the kv-quant storage-mode gauge charted next to
+    the arena capacity it doubles, and the draft-retreat guard gauge next
+    to the acceptance rate that trips it."""
+    refs = _dashboard_names()
+    for metric in ("kubeml_serving_kv_quant",
+                   "kubeml_serving_spec_disabled"):
+        assert metric in refs, f"no panel charts {metric}"
+    assert "kubeml_serving_pages_total" in refs
 
 
 def test_unique_panel_ids():

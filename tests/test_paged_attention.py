@@ -30,7 +30,9 @@ from kubeml_tpu.api.types import GenerateRequest
 from kubeml_tpu.models.generation import generate, init_paged_cache
 from kubeml_tpu.models.gpt import CausalTransformer
 from kubeml_tpu.ops.attention import dot_product_attention
-from kubeml_tpu.ops.paged_attention import paged_attention, resolve_paged_attn
+from kubeml_tpu.ops.paged_attention import (paged_attention,
+                                            resolve_kv_quant,
+                                            resolve_paged_attn)
 from kubeml_tpu.serving.batcher import PagedBatchingDecoder, _Row
 
 VOCAB = 101
@@ -366,6 +368,344 @@ def test_engine_int8_compose_parity_pallas():
             dec.close()
     assert outs["pallas"]["tokens"] == outs["gather"]["tokens"]
     assert outs["pallas"]["lengths"] == outs["gather"]["lengths"]
+
+
+# --- int8 KV-cache pages (ISSUE 16): quantized storage parity ---
+
+
+def quantize_pages(pages_f32):
+    """The write path's storage format, applied offline: per-page-per-head
+    absmax scales ``[N, H]``, values ``round(x * 127 / scale)`` int8."""
+    amax = np.abs(pages_f32).max(axis=(1, 3))  # [N, H]
+    s = np.maximum(amax, 1e-30)
+    q = np.clip(np.round(pages_f32 * 127.0 / s[:, None, :, None]),
+                -127, 127).astype(np.int8)
+    return jnp.asarray(q), jnp.asarray(amax, jnp.float32)
+
+
+def dequantize_pages(q_pages, scales):
+    return (np.asarray(q_pages, np.float32)
+            * (np.asarray(scales) / 127.0)[:, None, :, None])
+
+
+def test_resolve_kv_quant_values():
+    assert resolve_kv_quant(None) == "off"
+    assert resolve_kv_quant("off") == "off"
+    assert resolve_kv_quant("int8") == "int8"
+    # auto is reserved: resolves off everywhere until TPU parity evidence
+    assert resolve_kv_quant("auto") == "off"
+    with pytest.raises(ValueError):
+        resolve_kv_quant("fp8")
+
+
+@pytest.mark.kernel
+@pytest.mark.parametrize("L,positions", [
+    (1, [5, 0, 17]),        # per-token decode step at mixed depths
+    (4, [3, 0, 12]),        # spec verify window (k+1 = 4)
+    (8, [0, 8, 16]),        # suffix prefill, incl. page-aligned bases
+])
+def test_kernel_int8_parity_and_bounded_divergence(L, positions):
+    """The int8 kernel path against two references: the DEQUANTIZED gather
+    (same storage bytes, same q*s/127 reconstruction — must match at
+    f32-accumulation tolerance, the storage-format parity oracle) and the
+    unquantized f32 gather (divergence bounded by the int8 step size)."""
+    rng = np.random.default_rng(10)
+    B, H, D, pt, P, N = 3, 2, 16, 4, 6, 20
+    kf = rng.normal(size=(N, pt, H, D)).astype(np.float32)
+    vf = rng.normal(size=(N, pt, H, D)).astype(np.float32)
+    kq, ks = quantize_pages(kf)
+    vq, vs = quantize_pages(vf)
+    pages = jnp.asarray(rng.integers(1, N, size=(B, P)), jnp.int32)
+    q = jnp.asarray(rng.normal(size=(B, L, H, D)), jnp.float32)
+    pos = jnp.asarray(positions, jnp.int32)
+    out = paged_attention(q, kq, vq, pages, pos, k_scale=ks, v_scale=vs)
+    deq_ref = gather_reference(q, jnp.asarray(dequantize_pages(kq, ks)),
+                               jnp.asarray(dequantize_pages(vq, vs)),
+                               pages, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(deq_ref),
+                               atol=2e-5, rtol=2e-5)
+    f32_ref = gather_reference(q, jnp.asarray(kf), jnp.asarray(vf),
+                               pages, pos)
+    # bounded divergence: attention outputs are convex combinations of V
+    # rows, each off by at most one int8 step (~scale/127 ~ 0.03 for unit
+    # normals) plus the softmax shift from the K rounding
+    err = float(np.abs(np.asarray(out) - np.asarray(f32_ref)).max())
+    assert err < 0.1, f"int8 divergence {err} exceeds the storage bound"
+
+
+@pytest.mark.kernel
+def test_kernel_int8_poisoned_arena_cannot_leak():
+    """The poisoned-arena contract holds for quantized storage too: every
+    position a live row did not write — trash page 0, unallocated pages,
+    slots past each row's cursor — is poisoned with full-scale int8
+    values, and unallocated pages' (and trash's) SCALES are poisoned huge.
+    The output must be bit-identical to the clean-arena run."""
+    rng = np.random.default_rng(11)
+    B, H, D, pt, P, N = 2, 2, 8, 4, 4, 10
+    positions = np.array([5, 9])
+    L = 1
+    pages = np.zeros((B, P), np.int32)
+    pages[0, :2] = [3, 4]
+    pages[1, :3] = [5, 6, 7]
+    dense = np.zeros((N, pt, H, D), np.float32)
+    written = set()
+    live_pages = {3, 4, 5, 6, 7}
+    for b in range(B):
+        for p_log in range(positions[b] + L):
+            phys, off = pages[b, p_log // pt], p_log % pt
+            dense[phys, off] = rng.normal(size=(H, D))
+            written.add((phys, off))
+    kq, ks = quantize_pages(dense)
+    kq_p = np.asarray(kq).copy()
+    ks_p = np.asarray(ks).copy()
+    for phys in range(N):
+        for off in range(pt):
+            if (phys, off) not in written:
+                kq_p[phys, off] = 127
+        if phys not in live_pages:
+            ks_p[phys] = 1e9  # incl. trash page 0
+    q = jnp.asarray(rng.normal(size=(B, L, H, D)), jnp.float32)
+    pos = jnp.asarray(positions, jnp.int32)
+    pages = jnp.asarray(pages)
+    out_clean = paged_attention(q, kq, kq, pages, pos,
+                                k_scale=ks, v_scale=ks)
+    out_poison = paged_attention(q, jnp.asarray(kq_p), jnp.asarray(kq_p),
+                                 pages, pos, k_scale=jnp.asarray(ks_p),
+                                 v_scale=jnp.asarray(ks_p))
+    np.testing.assert_array_equal(np.asarray(out_clean),
+                                  np.asarray(out_poison))
+
+
+@pytest.mark.slow
+@pytest.mark.kernel
+def test_module_int8_kernel_matches_gather_oracle():
+    """Full paged decode under KUBEML_KV_QUANT=int8: prefill then steps —
+    the kernel and the dequantizing gather read the SAME quantized arena,
+    so their logits must agree at f32 tolerance; against the unquantized
+    model the divergence stays bounded."""
+    m = tiny(max_len=32)
+    variables = m.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.int32))
+    pt, tp = 4, 8
+    npages = 2 * tp + 1
+    prompt = np.arange(1, 11, dtype=np.int32)[None]
+    table = jnp.asarray([[1 + j for j in range(tp)]], jnp.int32)
+    outs = {}
+    for name, (impl, kvq) in {"i8-pallas": ("pallas", "int8"),
+                              "i8-gather": ("gather", "int8"),
+                              "f32": ("gather", "off")}.items():
+        mod = m.clone(page_tokens=pt, kv_pages=npages, paged_attn=impl,
+                      kv_quant=kvq)
+        cache = init_paged_cache(mod, variables, 1, tp)
+        if kvq == "int8":
+            arena = cache["block_0"]["attn"]
+            assert arena["k_pages"].dtype == jnp.int8
+            assert arena["k_scale"].shape == (npages, 2)
+        logits, vs = mod.apply(
+            {**variables, "cache": cache}, prompt, decode=True,
+            positions=jnp.zeros((1,), jnp.int32), pages=table,
+            seq_lens=jnp.asarray([10], jnp.int32), mutable=["cache"])
+        cache = vs["cache"]
+        chain = [logits[:, -1]]
+        tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        for i in range(4):
+            logits, vs = mod.apply(
+                {**variables, "cache": cache}, tok[:, None], decode=True,
+                positions=jnp.asarray([10 + i], jnp.int32), pages=table,
+                mutable=["cache"])
+            cache = vs["cache"]
+            chain.append(logits[:, -1])
+            tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        outs[name] = np.asarray(jnp.stack(chain))
+    np.testing.assert_allclose(outs["i8-pallas"], outs["i8-gather"],
+                               atol=1e-5, rtol=1e-5)
+    err = float(np.abs(outs["i8-gather"] - outs["f32"]).max())
+    assert 0 < err < 0.2, f"int8 logit divergence {err} out of bounds"
+
+
+@pytest.mark.slow
+def test_engine_int8_capacity_gauge_and_prefix_share():
+    """The serving acceptance: at the same arena byte budget int8 mode
+    admits >= 1.8x the pages, the kv_quant gauge exports 1, shared-prefix
+    pages (whose scales travel with them) still dedupe, and the mixed
+    workload's greedy tokens agree with the unquantized engine at the
+    token-agreement threshold."""
+    m = tiny(max_len=48)
+    variables = m.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    rng = np.random.default_rng(7)
+    sysp = rng.integers(1, VOCAB, size=8).astype(np.int32)
+    prompts = [
+        rng.integers(1, VOCAB, size=(1, 3)).astype(np.int32),
+        np.concatenate([sysp, rng.integers(1, VOCAB, size=4).astype(np.int32)])[None],
+        np.concatenate([sysp, rng.integers(1, VOCAB, size=2).astype(np.int32)])[None],
+        rng.integers(1, VOCAB, size=(1, 11)).astype(np.int32),
+    ]
+    max_news = [6, 8, 5, 3]
+    outs = {}
+    pages_total = {}
+    for kvq in ("off", "int8"):
+        dec = PagedBatchingDecoder(m, variables, slots=2, chunk_steps=4,
+                                   page_tokens=4, pages=25,
+                                   paged_attn="gather", kv_quant=kvq)
+        try:
+            results = drive(dec, prompts, max_news)
+            outs[kvq] = np.concatenate(
+                [np.asarray(r["tokens"][0]) for r in results])
+            assert results[2]["prefix_cached_tokens"] == 8
+            t = dec.telemetry()
+            pages_total[kvq] = t["pages_total"]
+            assert t["kv_quant"] == (1.0 if kvq == "int8" else 0.0)
+        finally:
+            dec.close()
+    # same byte budget, >= 1.8x the pages (f32 arenas actually reach ~4x;
+    # the scale arenas' overhead is charged by the derivation)
+    assert pages_total["int8"] >= 1.8 * pages_total["off"]
+    agreement = float(np.mean(outs["int8"] == outs["off"]))
+    assert agreement >= 0.9, f"token agreement {agreement} below threshold"
+
+
+@pytest.mark.slow
+def test_engine_int8_kv_read_bytes_storage_dtype():
+    """The accounting acceptance: modeled kv_read_bytes under int8 storage
+    is exactly itemsize-ratio smaller (f32 arenas: 4x) than the
+    unquantized engine's on the identical workload — the halving story on
+    /metrics, per caller."""
+    m = tiny()
+    variables = m.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    p = np.arange(1, 8, dtype=np.int32)[None]
+    read_bytes = {}
+    for kvq in ("off", "int8"):
+        dec = PagedBatchingDecoder(m, variables, slots=2, chunk_steps=4,
+                                   page_tokens=4, pages=33,
+                                   paged_attn="gather", kv_quant=kvq)
+        try:
+            dec.wait(dec.submit(GenerateRequest(prompts=p.tolist(),
+                                                max_new_tokens=6)),
+                     timeout=600)
+            read_bytes[kvq] = dec.stats.snapshot()["kv_read_bytes"]
+            token_bytes = dec._kv_token_bytes
+            itemsize = 1 if kvq == "int8" else 4
+            assert token_bytes == m.depth * 2 * m.embed_dim * itemsize
+        finally:
+            dec.close()
+    assert read_bytes["off"] == 4 * read_bytes["int8"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.kernel
+@pytest.mark.spec
+def test_engine_spec_rollback_over_quantized_pages():
+    """Speculative verify windows write k lookahead positions into int8
+    pages and the host rolls rejected drafts back by cursor. Rejected
+    drafts may have grown page scales (monotone absmax) — that is bounded
+    precision loss, never corruption: the kernel and gather engines read
+    the same quantized arena and must emit identical tokens."""
+    m = tiny(max_len=48)
+    variables = m.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, VOCAB, size=(1, l)).astype(np.int32)
+               for l in (5, 9)]
+    max_news = [7, 5]
+    outs = {}
+    for impl in ("pallas", "gather"):
+        dec = PagedBatchingDecoder(m, variables, slots=2, chunk_steps=4,
+                                   page_tokens=4, paged_attn=impl,
+                                   kv_quant="int8", spec="self", spec_k=2,
+                                   spec_adaptive=False, spec_exit_layer=1)
+        try:
+            outs[impl] = [r["tokens"][0] for r in drive(dec, prompts,
+                                                        max_news)]
+        finally:
+            dec.close()
+    assert outs["pallas"] == outs["gather"]
+
+
+@pytest.mark.slow
+@pytest.mark.paged
+def test_allocator_chaos_storm_int8_doubled_arena():
+    """The PR-12 chaos storm re-run with KUBEML_KV_QUANT=int8: the byte
+    budget of 41 f32 pages derives ~4x the page count, and under the
+    concurrent cancel/timeout/shed storm the pool invariants must hold
+    exactly at that doubled-plus capacity — every page returned once, the
+    trie the only holder at drain."""
+    import threading
+    import time
+
+    from kubeml_tpu.api.errors import KubeMLError
+    from kubeml_tpu.utils import resilience
+
+    m = tiny()
+    variables = m.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    dec = PagedBatchingDecoder(m, variables, slots=3, chunk_steps=8,
+                               page_tokens=4, pages=41, kv_quant="int8",
+                               paged_attn="gather", queue_limit=6,
+                               shed_policy="oldest")
+    assert dec._pool.num_pages >= 1.8 * 41
+    rng = np.random.default_rng(1234)
+    sysp = rng.integers(1, VOCAB, size=8).astype(np.int32)
+    errors = []
+
+    def client(i):
+        r = np.random.default_rng(1000 + i)
+        try:
+            for _ in range(3):
+                if r.random() < 0.4:
+                    prompt = np.concatenate(
+                        [sysp,
+                         r.integers(1, VOCAB, size=int(r.integers(2, 6)))])
+                else:
+                    prompt = r.integers(1, VOCAB, size=int(r.integers(3, 14)))
+                req = GenerateRequest(
+                    prompts=[prompt.astype(np.int32).tolist()],
+                    max_new_tokens=int(r.integers(2, 24)),
+                    temperature=0.7 if r.random() < 0.3 else 0.0,
+                    seed=int(r.integers(1, 1 << 30)))
+                roll = r.random()
+                try:
+                    if roll < 0.2:
+                        with resilience.bind_deadline(time.time() + 0.01):
+                            e = dec.submit(req)
+                        dec.wait(e, timeout=30)
+                    elif roll < 0.45:
+                        e = dec.submit(req)
+                        dec.wait(e, timeout=0.01)
+                    elif roll < 0.6:
+                        e = dec.submit(req)
+                        time.sleep(float(r.random()) * 0.05)
+                        dec.cancel(e)
+                    else:
+                        e = dec.submit(req)
+                        dec.wait(e, timeout=600)
+                except KubeMLError:
+                    pass
+        except Exception as exc:  # pragma: no cover - surfaced below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        assert not errors
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with dec._cond:
+                idle = (not dec._pending and not dec._busy()
+                        and not dec._draining)
+            if idle:
+                break
+            time.sleep(0.05)
+        assert idle, "engine did not drain"
+        chk = dec._pool.check()
+        assert chk["held"] == chk["trie_pages"]
+        dec._pool.trie.flush()
+        assert dec._pool.free_pages() == dec._pool.capacity
+        dec._pool.check()
+        with dec._cond:
+            assert sorted(dec._free) == [0, 1, 2]
+            assert all(r is None for r in dec._slot_rows)
+    finally:
+        dec.close()
 
 
 # --- KV-read accounting (satellite: kubeml_serving_kv_read_bytes_total) ---

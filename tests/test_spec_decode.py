@@ -483,6 +483,67 @@ def test_adaptive_k_pinned_when_not_adaptive():
     assert ctl.current() == 4
 
 
+# --- draft-mode retreat (ISSUE 16 satellite: KUBEML_SPEC_MIN_ACCEPT) ---
+
+
+def test_min_accept_permanently_disables_drafting():
+    ctl = AdaptiveK(4, cooldown=3, min_accept=0.10)
+    for _ in range(10):
+        ctl.on_step(drafted=8, accepted=0)
+    assert ctl.disabled
+    assert ctl.current() == 0
+    # permanent: no re-probe path, unlike the self-mode suspend ladder
+    for _ in range(50):
+        ctl.on_plain_chunk()
+    assert ctl.current() == 0
+    # and healthy late samples do not resurrect it — retreat is one-way
+    for _ in range(50):
+        ctl.on_step(drafted=4, accepted=4)
+    assert ctl.disabled
+    assert ctl.current() == 0
+
+
+def test_min_accept_waits_out_the_cooldown_window():
+    """The EWMA needs >= cooldown samples before the retreat can fire —
+    one cold verify window right after warmup must not kill the drafter."""
+    ctl = AdaptiveK(4, cooldown=5, min_accept=0.10)
+    for _ in range(4):
+        ctl.on_step(drafted=8, accepted=0)
+    assert not ctl.disabled  # 4 samples < cooldown 5
+    ctl.on_step(drafted=8, accepted=0)
+    assert ctl.disabled
+
+
+def test_min_accept_spares_a_healthy_drafter():
+    ctl = AdaptiveK(4, cooldown=2, min_accept=0.10)
+    for _ in range(100):
+        ctl.on_step(drafted=8, accepted=4)  # 50% acceptance
+    assert not ctl.disabled
+    assert ctl.current() >= 1
+
+
+def test_min_accept_fires_even_when_not_adaptive():
+    """The guard protects against a BROKEN drafter config, not a workload
+    phase — it must fire under spec_adaptive=off, where the k ladder is
+    pinned and nothing else can stop the pure-overhead verify loop."""
+    ctl = AdaptiveK(4, adaptive=False, cooldown=3, min_accept=0.10)
+    for _ in range(10):
+        ctl.on_step(drafted=8, accepted=0)
+    assert ctl.disabled
+    assert ctl.current() == 0
+
+
+def test_min_accept_zero_is_off_and_validation():
+    ctl = AdaptiveK(4, cooldown=1, min_accept=0.0)
+    for _ in range(100):
+        ctl.on_step(drafted=8, accepted=0)
+    assert not ctl.disabled  # 0.0 disables the guard entirely
+    with pytest.raises(ValueError):
+        AdaptiveK(4, min_accept=1.0)
+    with pytest.raises(ValueError):
+        AdaptiveK(4, min_accept=-0.1)
+
+
 # --- the jit-cache-key regression (satellite: small fix) ---
 
 
