@@ -158,10 +158,12 @@ def main():
     model = make_synthetic_model(fs.module, "bench-synthetic", uint8_inputs=True)
 
     n_workers = max(1, len(jax.devices()))
-    batch = 128
-    k = 8  # sync every 8 local steps (BASELINE target config)
     # defaults are the driver contract; env overrides exist so the full body
-    # stays drivable on a CPU dev box (smaller rounds, same code path)
+    # stays drivable on a CPU dev box (smaller rounds/batches, same code
+    # path — bf16 resnet18 emulates at <1 sample/sec on a 1-core CPU, so a
+    # production-sized round alone is ~an hour there)
+    batch = int(os.environ.get("KUBEML_BENCH_BATCH", 128))
+    k = int(os.environ.get("KUBEML_BENCH_K", 8))  # sync every k local steps
     rounds = int(os.environ.get("KUBEML_BENCH_ROUNDS", 20))
     reps = int(os.environ.get("KUBEML_BENCH_REPS", 3))
     # report the best rep: one slow host hiccup must not define the number
@@ -277,6 +279,15 @@ def main():
                 "metric": f"{fs.name}-kavg-train-throughput",
                 "value": round(device_sps, 1),
                 "unit": "samples/sec",
+                # self-describing run shape: a reduced CPU-dev-box drive
+                # (env overrides above) must never read as the production
+                # config (batch=128, k=8, rounds=20, reps=3)
+                "config": {"batch": batch, "k": k, "rounds": rounds,
+                           "reps": reps, "n_workers": n_workers,
+                           "codec": os.environ.get(
+                               "KUBEML_DATAPLANE_CODEC", "raw"),
+                           "prefetch": os.environ.get(
+                               "KUBEML_DATAPLANE_PREFETCH", "1")},
                 "mfu": round(mfu, 4) if mfu is not None else None,
                 # the CEILING the program's arithmetic intensity allows —
                 # measured mfu near it means bandwidth-bound, not kernel slack

@@ -29,6 +29,11 @@ def _env_int(name: str, default: int) -> int:
     return int(v) if v else default
 
 
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v else default
+
+
 @dataclass
 class Config:
     # --- data root: datasets, function registry, history, checkpoints ---
@@ -296,6 +301,15 @@ class Config:
     # overload, bounding queue wait instead of queue depth alone
     serving_shed_policy: str = field(
         default_factory=lambda: os.environ.get("KUBEML_SERVING_SHED", "reject"))
+    # compile-storm threshold for the serving engine's compile tracker
+    # (serving/stats.py): a warning logs and kubeml_serving_compile_storm
+    # flips to 1 while the 60s compile rate exceeds this many compiles per
+    # minute — sustained compiles in steady state mean shape churn (the
+    # PR-15 +14% regression's signature). 0 disables the warning; the
+    # counters/histograms record regardless.
+    compile_storm_per_min: float = field(
+        default_factory=lambda: _env_float("KUBEML_COMPILE_STORM_PER_MIN",
+                                           6.0))
     # SHARDED serving: axis spec like "tp=2" — finished (sharded) checkpoints
     # restore straight onto this mesh and the batcher runs one SPMD decode
     # program over it, so a model too big for one chip still serves. Empty

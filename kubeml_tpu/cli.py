@@ -671,6 +671,34 @@ def cmd_top(args) -> int:
             _print_table(cols, rows)
         else:
             print("(no serving traffic sampled yet)")
+        # --- interference rows: the latency-anatomy attribution signals
+        # (PR 18) — head-of-line stall charged to co-scheduled decoders,
+        # inter-token p99, and the compile tracker. A row renders only for
+        # models where at least one signal has data, so a quiet dense
+        # engine doesn't print a dash-only table.
+        icols = ("MODEL", "HOL-S/S", "ITL-P99", "COMPILES", "COMP/MIN",
+                 "STORM")
+        irows = []
+        for m in models:
+            hol = metric(series, "kubeml_serving_hol_stall_seconds_total",
+                         m, "rate")
+            itl = metric(series, "kubeml_serving_itl_p99_seconds", m,
+                         "max", "latest")
+            comp = metric(series, "kubeml_serving_compiles_total", m,
+                          "latest")
+            cpm = metric(series, "kubeml_serving_compiles_per_minute", m,
+                         "latest")
+            storm = metric(series, "kubeml_serving_compile_storm", m,
+                           "latest")
+            if all(v is None for v in (hol, itl, comp, cpm, storm)):
+                continue
+            irows.append((m, fmt(hol, 3), fmt(itl, 3), fmt(comp, 0),
+                          fmt(cpm, 1),
+                          "-" if storm is None
+                          else ("YES" if storm else "no")))
+        if irows:
+            print("\ninterference:")
+            _print_table(icols, irows)
         # --- training rows: the per-job gauges the sampler folds into the
         # tsdb (parallelism + the statistical-efficiency signals). The
         # ring retains a finished job's last samples, so a LIVE view must

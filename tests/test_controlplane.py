@@ -438,9 +438,15 @@ class TestClusterEndToEnd:
             hist = client.histories().get(j)
             assert hist.id == j
         assert client.tasks().list() == []
+        # every per-job GAUGE clears on finish (reference metrics.go:100-106);
+        # per-job HISTOGRAM series deliberately linger — the distribution is
+        # the artifact, bounded by MAX_HISTOGRAM_JOBS eviction (metrics.py)
+        from kubeml_tpu.ps.metrics import GAUGES
+
         text = requests.get(f"{cluster.ps_api.url}/metrics", timeout=5).text
         for j in ids:
-            assert f'jobid="{j}"' not in text
+            for metric in GAUGES:
+                assert f'{metric}{{jobid="{j}"}}' not in text, metric
         assert 'kubeml_job_running_total{type="train"} 0' in text
 
 

@@ -60,6 +60,14 @@ def _exported_names() -> set:
     stats.admitted_wave()
     stats.chunk()
     stats.chunk_fetched(0.08, 8)
+    # latency anatomy (PR 18): colocated decode split, ITL, HOL stall,
+    # cold-start quarantine, and the compile tracker
+    stats.chunk_fetched(0.09, 8, colocated=True)
+    stats.inter_token(0.02)
+    stats.hol_stall(0.1, 2)
+    stats.cold_start(0.5)
+    if stats.compile_begin("step", (8,)):
+        stats.compiled("step", 0.4)
     stats.chunk_occupancy(8, 20, 6, 6)
     stats.admit_tokens(10, 22)
     stats.kv_read(1 << 20, 0.01)
@@ -186,6 +194,95 @@ def test_kv_quant_and_spec_disabled_panels_present():
                    "kubeml_serving_spec_disabled"):
         assert metric in refs, f"no panel charts {metric}"
     assert "kubeml_serving_pages_total" in refs
+
+
+def test_latency_anatomy_panels_present():
+    """The PR-18 panels: inter-token latency, head-of-line stall, the
+    cause-split decode-step histogram, per-program compiles, and the
+    quarantined compile/cold-start walls."""
+    refs = _dashboard_names()
+    for metric in ("kubeml_serving_itl_p99_seconds",
+                   "kubeml_serving_inter_token_seconds_bucket",
+                   "kubeml_serving_hol_stall_seconds_total",
+                   "kubeml_serving_decode_step_seconds_bucket",
+                   "kubeml_serving_compiles_total",
+                   "kubeml_serving_compiled_programs",
+                   "kubeml_serving_compile_storm",
+                   "kubeml_serving_compile_seconds_bucket",
+                   "kubeml_serving_cold_start_seconds_bucket"):
+        assert metric in refs, f"no panel charts {metric}"
+
+
+# Exported metrics deliberately NOT charted — the reverse drift guard
+# (below) fails on any exported name missing from BOTH the dashboard and
+# this allowlist, so a new metric must ship with either a panel or a
+# written reason. Histogram _count/_sum/_bucket siblings of a charted
+# family never need listing (the guard strips suffixes on both sides).
+UNPANELED = {
+    # debug/internals: useful in ad-hoc PromQL, too noisy as panels
+    "kubeml_dataplane_events_total": "per-event codec debug counter",
+    "kubeml_dataplane_seconds_total": "per-event codec debug counter",
+    "kubeml_http_breaker_rejected_total": "client-resilience internals",
+    "kubeml_http_deadline_expired_total": "client-resilience internals",
+    "kubeml_http_idempotent_replays_total": "client-resilience internals",
+    "kubeml_http_retry_budget_exhausted_total":
+        "client-resilience internals",
+    # raw inputs to ratios/histograms that ARE charted
+    "kubeml_job_epoch": "epoch progress charted via epoch_duration",
+    "kubeml_job_epoch_seconds": "charted as kubeml_job_epoch_duration",
+    "kubeml_job_merge_seconds": "merge wall folds into round-time panels",
+    "kubeml_job_round_seconds": "round wall folds into round-time panels",
+    "kubeml_job_moe_overflow": "model-specific; ad-hoc only",
+    "kubeml_preempt_yield_seconds": "yield wall; preemptions_total charted",
+    "kubeml_serving_admission_waves_total": "denominator of admit ratios",
+    "kubeml_serving_chunks_total": "denominator of per-chunk rates",
+    "kubeml_serving_fetcher_utilization": "pipeline debug gauge",
+    "kubeml_serving_prefill_tokens_total": "input to goodput ratio panel",
+    "kubeml_serving_spec_steps_total": "denominator of spec accept rate",
+    "kubeml_serving_spec_accept_rate": "ratio derived on-panel from totals",
+    "kubeml_serving_requests_submitted_total": "completed/failed charted",
+    "kubeml_serving_requests_canceled_total": "folded into failure panels",
+    # ring-quantile gauges shadowing charted histograms (the histogram
+    # panels chart the same signal with bucket accuracy)
+    "kubeml_serving_first_token_p50_seconds": "hist panel charts TTFT",
+    "kubeml_serving_first_token_p95_seconds": "hist panel charts TTFT",
+    "kubeml_serving_first_token_p99_seconds": "hist panel charts TTFT",
+    "kubeml_serving_first_token_max_seconds": "hist panel charts TTFT",
+    "kubeml_serving_request_seconds": "request-latency ring + histogram",
+    # static capacity/config gauges: constants, not timelines
+    "kubeml_serving_page_tokens": "static config gauge",
+    "kubeml_serving_queue_limit": "static config gauge",
+    "kubeml_serving_slots_busy": "occupancy ratio panel charts this",
+    "kubeml_serving_slots_total": "static capacity gauge",
+    "kubeml_serving_weight_bytes": "static per-model constant",
+}
+
+
+def test_every_exported_metric_is_paneled_or_allowlisted():
+    """Reverse drift guard (PR 18): a metric the fully-seeded registry
+    exports but no panel charts is invisible telemetry — dead code at
+    best, a silently-regressing signal at worst. Every exported name must
+    appear in some panel expr or carry a documented UNPANELED reason."""
+    def base(name):
+        for suf in _HIST_SUFFIXES + ("_p50", "_p95", "_p99", "_max"):
+            if name.endswith(suf):
+                return name[: -len(suf)]
+        return name
+
+    paneled = set()
+    for name in _dashboard_names():
+        paneled.add(name)
+        paneled.add(base(name))
+    unaccounted = sorted(
+        name for name in _exported_names()
+        if name not in paneled and base(name) not in paneled
+        and name not in UNPANELED and base(name) not in UNPANELED)
+    assert not unaccounted, (
+        "exported metrics with neither a dashboard panel nor an UNPANELED "
+        f"reason: {unaccounted}")
+    stale = sorted(n for n in UNPANELED if not any(
+        e == n or base(e) == n for e in _exported_names()))
+    assert not stale, f"UNPANELED entries no module exports: {stale}"
 
 
 def test_unique_panel_ids():

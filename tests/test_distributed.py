@@ -189,6 +189,15 @@ def test_dashboard_metrics_exist_in_registry():
     # _bucket series renders (the KV-read panel queries it); the
     # paged_attn gauge rides the snapshot like the engine's telemetry
     stats.kv_read(1 << 20, 0.01)
+    # latency-anatomy signals (PR 18 panels: ITL quantiles + histogram,
+    # HOL stall rate, the cause-split decode histogram, per-program
+    # compiles and the cold-start/compile quantile panels)
+    stats.chunk_fetched(0.09, 8, colocated=True)
+    stats.inter_token(0.02)
+    stats.hol_stall(0.1, 2)
+    stats.cold_start(0.5)
+    if stats.compile_begin("step", (8,)):
+        stats.compiled("step", 0.4)
     snap = stats.snapshot()
     snap["paged_attn_kernel"] = 0.0
     reg.set_serving_source(lambda: {"m": snap})

@@ -1,0 +1,261 @@
+"""Serving latency anatomy (ISSUE 18): inter-token timelines, head-of-line
+stall attribution, and the compile tracker.
+
+Correctness bars:
+
+* COLD QUARANTINE — the first call of every jitted program traces and
+  XLA-compiles synchronously, so its wall is compile wall, not serving
+  latency: it must land in ``cold_start_seconds`` (and the per-program
+  ``compile_seconds``/``compiles_total`` tracker) and NEVER in the
+  steady-state ``first_token``/``decode_step`` histograms — the regression
+  the PR-18 acceptance names explicitly.
+* ITL EDGES — a request with zero or one emission has no inter-token gap:
+  nothing observed, payload quantiles 0.0, no ``itl_*`` snapshot keys. The
+  quantile ring evicts at ``LATENCY_RING`` while the cumulative histogram
+  retains every observation.
+* HOL CHARGE — only live rows with undispatched host-known work are
+  charged; rows done, canceled, or fully dispatched (retired mid-chunk)
+  are excluded by the ``_stalled_rows`` snapshot on BOTH engines.
+* COMPILE DEDUP — ``compile_begin`` is first-seen per (program, shape
+  signature): cache hits never count; a rebuilt engine (the env-toggle
+  clone path: KUBEML_PAGED_ATTN / KUBEML_KV_QUANT flips re-trace every
+  program) counts again on its fresh tracker.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubeml_tpu.api.types import GenerateRequest
+from kubeml_tpu.models.gpt import CausalTransformer
+from kubeml_tpu.serving.batcher import (BatchingDecoder,
+                                        PagedBatchingDecoder, _Row)
+from kubeml_tpu.serving.stats import LATENCY_RING, DecoderStats
+
+VOCAB = 101
+
+
+def tiny():
+    return CausalTransformer(vocab_size=VOCAB, max_len=64, embed_dim=64,
+                             depth=2, num_heads=4)
+
+
+@pytest.fixture(scope="module")
+def served():
+    m = tiny()
+    variables = m.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    return m, variables
+
+
+def req(prompt, n):
+    return GenerateRequest(prompts=np.asarray(prompt, np.int32).tolist(),
+                           max_new_tokens=n)
+
+
+# --- cold-compile quarantine (the acceptance regression) ---
+
+
+def test_cold_compile_excluded_from_steady_state(served):
+    """On a FRESH decoder the first request's walls are dominated by XLA
+    compiles: they must land in cold_start only. The second (warm, same
+    shapes) request is the first to feed the steady-state histograms."""
+    m, variables = served
+    dec = BatchingDecoder(m, variables, slots=2, chunk_steps=4)
+    try:
+        p = np.arange(1, 9, dtype=np.int32)[None]
+        dec.wait(dec.submit(req(p, 6)), timeout=300)
+        snap1 = dec.stats.snapshot()
+        hist1 = snap1.get("hist", {})
+        # the cold walls went to the quarantine series...
+        assert hist1.get("cold_start", {}).get("count", 0) >= 1
+        # ...and NOT into the steady-state first-token histogram or ring
+        assert "first_token" not in hist1, (
+            f"cold first-token wall leaked into the steady-state "
+            f"histogram: {hist1['first_token']}")
+        assert "first_token_p50_seconds" not in snap1
+        # the compile tracker attributed every first call per program
+        assert snap1["compiles"]["prefill"] >= 1
+        assert snap1["compiles"]["step"] >= 1
+        assert snap1["compiled_programs"] >= 2
+        assert hist1.get("compile", {}).get("count", 0) >= 2
+
+        dec.wait(dec.submit(req(p, 6)), timeout=300)
+        snap2 = dec.stats.snapshot()
+        hist2 = snap2.get("hist", {})
+        # warm request: exactly its one first-token observation, no new
+        # compiles
+        assert hist2.get("first_token", {}).get("count") == 1
+        assert hist2.get("decode_step", {}).get("count", 0) >= 1
+        assert snap2["compiles"] == snap1["compiles"]
+    finally:
+        dec.close()
+
+
+def test_warm_rebuild_recounts_compiles(served):
+    """The clone path (KUBEML_PAGED_ATTN / KUBEML_KV_QUANT toggles rebuild
+    the engine) re-traces every program: a fresh engine's tracker counts
+    them again, while repeat shapes within ONE engine stay cache hits."""
+    m, variables = served
+    p = np.arange(1, 9, dtype=np.int32)[None]
+    counts = []
+    for _ in range(2):
+        dec = BatchingDecoder(m, variables, slots=2, chunk_steps=4)
+        try:
+            dec.wait(dec.submit(req(p, 6)), timeout=300)
+            before = dict(dec.stats.compiles)
+            dec.wait(dec.submit(req(p, 6)), timeout=300)
+            assert dict(dec.stats.compiles) == before, (
+                "a cache-hit program bumped compiles_total")
+            counts.append(before)
+        finally:
+            dec.close()
+    assert counts[1]["prefill"] >= 1 and counts[1]["step"] >= 1, (
+        "a rebuilt engine's re-traces were not counted on its tracker")
+
+
+# --- ITL edges + ring-vs-histogram retention ---
+
+
+def test_itl_zero_and_one_emission(served):
+    m, variables = served
+    dec = BatchingDecoder(m, variables, slots=2, chunk_steps=4)
+    try:
+        p = np.arange(1, 9, dtype=np.int32)[None]
+        # n=1: exactly one emission, no gap
+        r1 = dec.wait(dec.submit(req(p, 1)), timeout=300)
+        assert r1["itl_p99"] == 0.0 and r1["itl_max"] == 0.0
+        assert "itl_p99_seconds" not in dec.stats.snapshot()
+        assert "inter_token" not in dec.stats.snapshot().get("hist", {})
+        # n>1: at least one delta arrival after the first token
+        r2 = dec.wait(dec.submit(req(p, 8)), timeout=300)
+        assert r2["itl_p99"] > 0.0
+        assert r2["itl_max"] >= r2["itl_p99"]
+        snap = dec.stats.snapshot()
+        assert snap["itl_p99_seconds"] > 0.0
+        assert snap["hist"]["inter_token"]["count"] >= 1
+        assert "hol_stall_seconds" in r2  # payload field always present
+    finally:
+        dec.close()
+
+
+def test_itl_ring_evicts_histogram_retains():
+    stats = DecoderStats(slots=4)
+    stats.inter_token(5.0)  # a huge early gap the ring will evict
+    for _ in range(LATENCY_RING):
+        stats.inter_token(0.001)
+    snap = stats.snapshot()
+    # cumulative histogram kept every observation, including the evicted one
+    assert snap["hist"]["inter_token"]["count"] == LATENCY_RING + 1
+    assert snap["hist"]["inter_token"]["sum"] >= 5.0
+    # the quantile ring is bounded and no longer sees the evicted max
+    assert len(stats._itl) == LATENCY_RING
+    assert snap["itl_max_seconds"] == pytest.approx(0.001)
+
+
+# --- HOL stall: charge semantics + the mid-chunk-retire exclusion ---
+
+
+def test_hol_stall_accumulates_per_stalled_row():
+    stats = DecoderStats(slots=4)
+    stats.hol_stall(0.5, 3)
+    stats.hol_stall(0.25, 1)
+    stats.hol_stall(0.1, 0)   # no victims: nothing charged
+    stats.hol_stall(-1.0, 4)  # clock skew guard
+    assert stats.snapshot()["hol_stall_seconds"] == pytest.approx(1.75)
+
+
+def _fake_row(max_new, done=False, canceled=False, dispatched=0):
+    return _Row(entry=None, index=0, prompt=np.arange(4, dtype=np.int32),
+                max_new=max_new, temp=0.0, topk=0, eos=-1,
+                key=np.zeros(2, np.uint32), done=done, canceled=canceled,
+                dispatched=dispatched)
+
+
+def test_stalled_rows_excludes_retired_dense(served):
+    m, variables = served
+    dec = BatchingDecoder(m, variables, slots=4, chunk_steps=4)
+    dec.close()  # engine stopped: safe to fabricate slot state
+    live = _fake_row(max_new=10)
+    finished = _fake_row(max_new=10, done=True)
+    canceled = _fake_row(max_new=10, canceled=True)
+    exhausted = _fake_row(max_new=5)  # every emission already dispatched
+    dec._slot_rows = [live, finished, canceled, exhausted]
+    dec._steps_ahead = [2, 2, 2, 4]  # exhausted: max_new-1 == dispatched
+    assert dec._stalled_rows() == [live]
+
+
+def test_stalled_rows_excludes_retired_paged(served):
+    m, variables = served
+    dec = PagedBatchingDecoder(m, variables, slots=4, chunk_steps=4,
+                               page_tokens=4)
+    dec.close()
+    live = _fake_row(max_new=10, dispatched=2)
+    retired = _fake_row(max_new=5, dispatched=4)  # retired mid-chunk
+    finished = _fake_row(max_new=10, done=True, dispatched=1)
+    dec._slot_rows = [live, retired, finished, None]
+    assert dec._stalled_rows() == [live]
+
+
+# --- compile tracker: dedup + storm flag ---
+
+
+def test_compile_begin_first_seen_per_signature():
+    stats = DecoderStats(slots=4)
+    assert stats.compile_begin("step", (4,)) is True
+    assert stats.compile_begin("step", (4,)) is False  # cache hit
+    assert stats.compile_begin("step", (8,)) is True   # new shape
+    assert stats.compile_begin("prefill", (4,)) is True  # new program
+    stats.compiled("step", 0.5)
+    stats.compiled("step", 0.3)
+    stats.compiled("prefill", 1.0)
+    snap = stats.snapshot()
+    assert snap["compiles"] == {"step": 2, "prefill": 1}
+    assert snap["compiled_programs"] == 3.0
+    assert snap["hist"]["compile"]["count"] == 3
+    assert snap["hist"]["compile"]["sum"] == pytest.approx(1.8)
+
+
+def test_compile_storm_flag():
+    stats = DecoderStats(slots=4)
+    stats.compile_storm_per_min = 0.5
+    for _ in range(3):
+        stats.compile_begin("step", (object(),))
+        stats.compiled("step", 0.1)
+    snap = stats.snapshot()
+    assert snap["compiles_per_minute"] > 0.5
+    assert snap["compile_storm"] == 1.0
+    calm = DecoderStats(slots=4)
+    calm.compile_storm_per_min = 0.5
+    assert calm.snapshot()["compile_storm"] == 0.0
+
+
+# --- exposition: the cause split renders under ONE metric name ---
+
+
+def test_cause_labeled_decode_step_render():
+    from kubeml_tpu.ps.metrics import MetricsRegistry
+
+    stats = DecoderStats(slots=4)
+    stats.chunk_fetched(0.04, 8)
+    stats.chunk_fetched(0.4, 8, colocated=True)
+    stats.chunk_fetched(9.9, 8, cold=True)  # quarantined, not labeled
+    stats.hol_stall(0.2, 2)
+    stats.compile_begin("step", (8,))
+    stats.compiled("step", 0.7)
+    reg = MetricsRegistry()
+    reg.set_serving_source(lambda: {"m1": stats.snapshot()})
+    text = reg.render()
+    assert ('kubeml_serving_decode_step_seconds_bucket{model="m1",'
+            'cause="clean",le="0.005"} 1') in text
+    assert 'cause="prefill_colocated"' in text
+    # the cold observation reached neither cause series
+    clean = [l for l in text.splitlines()
+             if l.startswith("kubeml_serving_decode_step_seconds_count")]
+    assert all(l.rsplit(" ", 1)[1] == "1" for l in clean)
+    assert "kubeml_serving_cold_start_seconds_bucket" in text
+    assert ('kubeml_serving_hol_stall_seconds_total{model="m1"} 0.4'
+            in text)
+    assert ('kubeml_serving_compiles_total{model="m1",program="step"} 1'
+            in text)
+    assert 'kubeml_serving_compiled_programs{model="m1"} 1' in text

@@ -87,7 +87,10 @@ def test_stats_histograms_and_exposition():
     assert 'kubeml_serving_request_seconds_bucket{model="m1",le="+Inf"} 2' in text
     assert 'kubeml_serving_request_seconds_count{model="m1"} 2' in text
     assert 'kubeml_serving_first_token_seconds_bucket{model="m1",le="0.025"} 1' in text
-    assert 'kubeml_serving_decode_step_seconds_bucket{model="m1",le="0.005"} 1' in text
+    # the decode-step histogram renders cause-labeled (ISSUE 18): clean
+    # chunks vs chunks that shared the device with prefill work
+    assert ('kubeml_serving_decode_step_seconds_bucket'
+            '{model="m1",cause="clean",le="0.005"} 1') in text
     # no-traffic decoders render headers but no bucket series (valid prom)
     reg.set_serving_source(lambda: {"m2": {"tokens_emitted": 0.0}})
     text = reg.render()
