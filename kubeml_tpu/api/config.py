@@ -340,6 +340,16 @@ class Config:
     # prefill runs only on the unshared suffix
     serving_prefix_cache: bool = field(
         default_factory=lambda: _env_bool("KUBEML_SERVING_PREFIX_CACHE", True))
+    # chunked prefill (Sarathi-style): a cold prompt whose unshared suffix
+    # exceeds this many tokens prefills in page-aligned chunks interleaved
+    # with decode steps, one chunk per engine-loop iteration, so a long
+    # prompt no longer stalls every decoding row behind one monolithic
+    # prefill program. The cap pow2-buckets down to a multiple of
+    # serving_page_tokens (bounded program set; chunk boundaries stay
+    # page-aligned). 0 (default) = monolithic prefill — today's behavior
+    # and the chunked path's parity oracle.
+    prefill_chunk_tokens: int = field(
+        default_factory=lambda: _env_int("KUBEML_PREFILL_CHUNK_TOKENS", 0))
     # how the paged engine READS the KV arena (ops/paged_attention.py):
     # "pallas" attends straight through the page table with the streaming
     # Pallas kernel (KV traffic scales with each row's actual depth, no

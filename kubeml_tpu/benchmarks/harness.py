@@ -53,6 +53,14 @@ GATE_METRICS = {
     # the ideal storage ratio (2.0 for bf16 arenas), so the 10% threshold
     # holds the measured candidate to >= ~1.8x admitted tokens.
     "kv_quant_capacity_ratio": ("kv_quant_capacity_ratio", "higher"),
+    # chunked prefill (results/chunked_prefill.jsonl rows,
+    # benchmarks/scenarios.py run_chunked_prefill): head-of-line decode
+    # seconds charged per completed request on the mixed short/long
+    # workload — the number KUBEML_PREFILL_CHUNK_TOKENS exists to push
+    # down; a candidate whose chunking regresses (more stall per request)
+    # fails the gate
+    "serving_hol_stall_per_request": ("hol_stall_seconds_per_request",
+                                      "lower"),
 }
 
 

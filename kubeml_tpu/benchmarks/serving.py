@@ -37,13 +37,14 @@ def _model(max_len: int):
     return GPTSmall(vocab_size=VOCAB, max_len=max_len, dtype=jnp.bfloat16)
 
 
-def one_shot_rate(batch: int, new_tokens: int = NEW_TOKENS, reps: int = 3) -> float:
+def one_shot_rate(batch: int, new_tokens: int = NEW_TOKENS, reps: int = 3,
+                  prompt_len: int = PROMPT_LEN) -> float:
     """Same-chip comparator: the jitted one-shot batch-N decode rate."""
     from ..models.generation import make_generate_fn
 
-    module = _model(PROMPT_LEN + new_tokens)
+    module = _model(prompt_len + new_tokens)
     r = np.random.default_rng(0)
-    prompt = jnp.asarray(r.integers(1, VOCAB, size=(batch, PROMPT_LEN)), jnp.int32)
+    prompt = jnp.asarray(r.integers(1, VOCAB, size=(batch, prompt_len)), jnp.int32)
     variables = module.init(jax.random.PRNGKey(0), prompt)
     fn = make_generate_fn(module, max_new_tokens=new_tokens)
     np.asarray(fn(variables, prompt, jax.random.PRNGKey(0)).tokens)  # compile
@@ -60,7 +61,8 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
              quantize: str = "", int8_matmul: bool = False,
              paged: bool = False, mixed_prompts: bool = False,
              long_workload: bool = False, spec: str = "off",
-             spec_k: int = 4) -> dict:
+             spec_k: int = 4, long_context: bool = False,
+             prefill_chunk_tokens: int = 0) -> dict:
     """N HTTP clients against a live cluster serving a final checkpoint.
 
     ``paged`` routes serving through the paged KV-cache engine
@@ -69,7 +71,12 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
     traffic the paged allocator exists for. ``spec`` ("draft"|"self")
     turns on speculative decoding (implies ``paged``); the row then
     carries ``spec_tokens_per_step`` and ``spec_accept_ratio`` scraped
-    from the PS /metrics exposition — the gated drafter-quality truth."""
+    from the PS /metrics exposition — the gated drafter-quality truth.
+    ``long_context`` (implies ``paged``) serves >= 2k-token prompts, each
+    client with its OWN random prompt so every admission is a full cold
+    prefill; ``prefill_chunk_tokens`` threads the chunked-prefill knob
+    (KUBEML_PREFILL_CHUNK_TOKENS) so the long-context row can be measured
+    monolithic vs chunked."""
     import os
     import socket
     import tempfile
@@ -90,19 +97,23 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
     spec = (spec or "off").lower()
     if spec != "off":
         paged = True  # speculation lives on the paged engine
+    if long_context:
+        paged = True  # chunked prefill lives on the paged engine
+    plen = max(2048, PROMPT_LEN) if long_context else PROMPT_LEN
     cfg = Config(controller_port=fp(), scheduler_port=fp(), ps_port=fp(),
                  storage_port=fp(), serving_slots=slots,
                  serving_chunk_steps=chunk_steps, serving_quantize=quantize,
                  int8_matmul=int8_matmul, serving_paged=paged,
-                 serving_spec=spec, spec_k=spec_k)
+                 serving_spec=spec, spec_k=spec_k,
+                 prefill_chunk_tokens=prefill_chunk_tokens)
     cfg.ensure_dirs()
     set_config(cfg)
 
     # a servable "finished job": random-init GPT-2-small weights exported as
     # the final checkpoint of a synthetic LM function
-    module = _model(PROMPT_LEN + new_tokens)
+    module = _model(plen + new_tokens)
     r = np.random.default_rng(0)
-    prompt = np.asarray(r.integers(1, VOCAB, size=(1, PROMPT_LEN)), np.int32)
+    prompt = np.asarray(r.integers(1, VOCAB, size=(1, plen)), np.int32)
     import flax.linen as nn
 
     variables = jax.tree.map(
@@ -120,7 +131,7 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
         "        super().__init__(D())\n"
         "    def build(self):\n"
         f"        return GPTSmall(vocab_size={VOCAB}, "
-        f"max_len={PROMPT_LEN + new_tokens}, dtype=jnp.bfloat16)\n"
+        f"max_len={plen + new_tokens}, dtype=jnp.bfloat16)\n"
     )
     from ..functions.registry import FunctionRegistry
 
@@ -138,10 +149,20 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
     # slot engine wastes stripes on and the paged engine is built for
     bodies = [body] * clients
     if mixed_prompts:
-        lens = [8 + 8 * (i % (PROMPT_LEN // 8)) for i in range(clients)]
+        lens = [8 + 8 * (i % (plen // 8)) for i in range(clients)]
         bodies = [{**body,
                    "prompts": prompt[:, :lens[i]].tolist()}
                   for i in range(clients)]
+    if long_context:
+        # every client gets its OWN >= 2k-token prompt: no prefix sharing,
+        # so each admission pays the full cold prefill the chunked path
+        # exists to interleave (mixed_prompts would re-slice ONE prompt and
+        # hand the trie most of the work after the first client)
+        bodies = [{**body,
+                   "prompts": np.asarray(
+                       r.integers(1, VOCAB, size=(1, plen)),
+                       np.int32).tolist()}
+                  for _ in range(clients)]
     # warmup: compiles prefill + admit + step-chunk once
     w = requests.post(f"{url}/generate", json=body, timeout=600)
     assert w.ok, w.text
@@ -210,6 +231,31 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
                     (accepted or 0.0) / drafted, 3)
         except Exception as e:  # the load row survives a scrape hiccup
             spec_metrics["spec_scrape_error"] = str(e)
+    lc_metrics = {}
+    if long_context:
+        # chunked-prefill truth off the PS /metrics scrape: total HOL
+        # decode-seconds charged, per completed request (the gated
+        # number), and how much prefill ran chunked
+        try:
+            text = requests.get(f"{cfg.ps_url}/metrics", timeout=30).text
+
+            def cval(name):
+                return sum(
+                    float(l.rsplit(" ", 1)[1]) for l in text.splitlines()
+                    if l.startswith(name + "{") or l.startswith(name + " "))
+
+            hol = cval("kubeml_serving_hol_stall_seconds_total")
+            done = cval("kubeml_serving_requests_completed_total")
+            lc_metrics["hol_stall_seconds"] = round(hol, 6)
+            if done:
+                lc_metrics["hol_stall_seconds_per_request"] = round(
+                    hol / done, 6)
+            lc_metrics["prefill_chunks"] = cval(
+                "kubeml_serving_prefill_chunks_total")
+            lc_metrics["prefill_chunk_tokens_total"] = cval(
+                "kubeml_serving_prefill_chunk_tokens_total")
+        except Exception as e:
+            lc_metrics["long_context_scrape_error"] = str(e)
     cluster.stop()
 
     total = sum(counts)
@@ -217,9 +263,11 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
         # only the explicit --long-workload flag renames the row: plain
         # --new-tokens 256 runs keep appending to the historical metric
         # name (results/serving_r5_load.jsonl trend tooling groups on it)
-        "metric": ("serving-long-workload-throughput" if long_workload
+        "metric": ("serving-long-context-throughput" if long_context
+                   else "serving-long-workload-throughput" if long_workload
                    else "serving-continuous-batching-throughput"),
         "clients": clients,
+        "prompt_len": plen,
         "slots": slots,
         "chunk_steps": chunk_steps,
         "new_tokens": new_tokens,
@@ -236,6 +284,10 @@ def run_load(clients: int, seconds: float, slots: int, chunk_steps: int,
         "errors": errors[:3],
         **({"spec": spec, "spec_k": spec_k} if spec != "off" else {}),
         **spec_metrics,
+        **({"long_context": True,
+            "prefill_chunk_tokens": prefill_chunk_tokens}
+           if long_context else {}),
+        **lc_metrics,
     }
 
 
@@ -273,27 +325,45 @@ def main(argv=None) -> int:
                         "results/SERVING_R5_NOTE.md measured, now tracked "
                         "through scripts/bench_compare.py "
                         "(serving_fraction_of_one_shot)")
+    p.add_argument("--long-context", action="store_true",
+                   help="first-class long-context scenario (implies "
+                        "--paged): every client sends its OWN >= 2k-token "
+                        "prompt — full cold prefill per admission; pair "
+                        "with --prefill-chunk-tokens to measure chunked "
+                        "vs monolithic")
+    p.add_argument("--prefill-chunk-tokens", type=int, default=0,
+                   help="KUBEML_PREFILL_CHUNK_TOKENS for the served "
+                        "engine: page-aligned prefill chunks interleaved "
+                        "with decode (0 = monolithic prefill)")
     p.add_argument("--skip-comparator", action="store_true")
     args = p.parse_args(argv)
     if args.long_workload:
         args.new_tokens = max(args.new_tokens, 256)
         args.mixed_prompts = True
+    prompt_len = PROMPT_LEN
+    if args.long_context:
+        args.paged = True
+        prompt_len = max(2048, PROMPT_LEN)
     # the dev chip is SHARED: its deliverable rate swings 2-7x between
     # minutes (observed comparator range 1.9k-14.6k tokens/sec for the same
     # program). Bracket the load window with comparator runs and score
     # against their mean so the fraction compares same-regime measurements.
-    ref_before = None if args.skip_comparator else one_shot_rate(args.slots, args.new_tokens)
+    ref_before = (None if args.skip_comparator
+                  else one_shot_rate(args.slots, args.new_tokens,
+                                     prompt_len=prompt_len))
     row = run_load(args.clients, args.seconds, args.slots, args.chunk_steps,
                    new_tokens=args.new_tokens, stagger=args.stagger,
                    quantize=args.quantize, int8_matmul=args.int8_matmul,
                    paged=args.paged, mixed_prompts=args.mixed_prompts,
                    long_workload=args.long_workload, spec=args.spec,
-                   spec_k=args.spec_k)
+                   spec_k=args.spec_k, long_context=args.long_context,
+                   prefill_chunk_tokens=args.prefill_chunk_tokens)
     if args.quantize:
         row["quantize"] = args.quantize
         row["int8_matmul"] = bool(args.int8_matmul)
     if not args.skip_comparator:
-        ref_after = one_shot_rate(args.slots, args.new_tokens)
+        ref_after = one_shot_rate(args.slots, args.new_tokens,
+                                  prompt_len=prompt_len)
         ref = (ref_before + ref_after) / 2
         row["batchN_decode_rate"] = round(ref, 1)
         row["batchN_before"] = round(ref_before, 1)

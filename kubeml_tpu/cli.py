@@ -637,8 +637,21 @@ def cmd_top(args) -> int:
             print("\x1b[2J\x1b[H", end="")  # clear + home
         print(time.strftime("kubeml top — %H:%M:%S  ")
               + f"(window {hist.get('stats_window', '?')}s)")
-        cols = ("MODEL", "TOK/S", "QUEUE", "OCC", "PAGES", "SPEC", "GOODPUT",
-                "DEAD/S", "TTFT-P99", "429/S")
+        cols = ("MODEL", "TOK/S", "QUEUE", "OCC", "PAGES", "PREFILL", "SPEC",
+                "GOODPUT", "DEAD/S", "TTFT-P99", "429/S")
+
+        def prefill_cell(m: str) -> str:
+            # chunked prefill (ISSUE 19): rows mid-prefill now / chunk
+            # dispatches per second — "-" until the paged engine reports
+            # the gauge (dense engines and chunking-off stay quiet)
+            inflight = metric(series, "kubeml_serving_prefills_in_progress",
+                              m, "latest")
+            cps = metric(series, "kubeml_serving_prefill_chunks_total", m,
+                         "rate")
+            if inflight is None and cps is None:
+                return "-"
+            return f"{fmt(inflight, 0)}/{fmt(cps, 1)}"
+
         rows = []
         for m in models:
             rows.append((
@@ -653,6 +666,7 @@ def cmd_top(args) -> int:
                 # dense slot engine, which has no page pool)
                 fmt(metric(series, "kubeml_serving_page_occupancy", m,
                            "mean", "latest")),
+                prefill_cell(m),
                 # speculative acceptance rate ("-" until a spec step ran)
                 fmt(metric(series, "kubeml_serving_spec_accept_rate", m,
                            "latest")),

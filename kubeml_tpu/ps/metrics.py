@@ -241,6 +241,17 @@ SERVING_COUNTERS = {
         "hol_stall_seconds",
         "Decode-seconds live rows lost waiting behind a dispatched chunk "
         "that carried admission/prefill work (seconds x stalled rows)"),
+    # chunked prefill (ISSUE 19): long cold prompts prefilled in
+    # page-aligned chunks interleaved with decode
+    # (KUBEML_PREFILL_CHUNK_TOKENS)
+    "kubeml_serving_prefill_chunks_total": (
+        "prefill_chunks",
+        "Per-row prefill chunk dispatches (intermediates plus the final "
+        "admission chunk of each chunked long-prompt row)"),
+    "kubeml_serving_prefill_chunk_tokens_total": (
+        "prefill_chunk_tokens",
+        "Prompt tokens prefilled via the chunked path (subset of "
+        "kubeml_serving_prefill_tokens_total)"),
 }
 # XLA compile counter, labeled {model, program} — rendered from the
 # snapshot's per-program compile-count dict rather than the scalar tables
@@ -407,6 +418,10 @@ SERVING_GAUGES = {
         "kv_quant", "1 when KV-cache pages are stored int8 with per-page "
                     "scale arenas (KUBEML_KV_QUANT), 0 for compute-dtype "
                     "storage"),
+    "kubeml_serving_prefills_in_progress": (
+        "prefills_in_progress",
+        "Rows currently mid-chunked-prefill: holding a slot and pages but "
+        "not yet decoding (KUBEML_PREFILL_CHUNK_TOKENS > 0)"),
     # speculative decoding (spec-mode decoders only)
     "kubeml_serving_spec_accept_rate": (
         "spec_accept_rate", "Lifetime speculative acceptance rate "

@@ -1356,7 +1356,8 @@ class ParameterServer:
                             prefix_cache=self.cfg.serving_prefix_cache,
                             paged_attn=self.cfg.paged_attn,
                             kv_quant=self.cfg.kv_quant,
-                            spec_min_accept=self.cfg.spec_min_accept)
+                            spec_min_accept=self.cfg.spec_min_accept,
+                            prefill_chunk_tokens=self.cfg.prefill_chunk_tokens)
             spec_kw = self._spec_decoder_args(module)
             try:
                 decoder = PagedBatchingDecoder(module, variables,

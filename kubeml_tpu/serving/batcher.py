@@ -248,6 +248,16 @@ class _Row:
     # and wall seconds the row lost stalled behind colocated prefill work
     itl: List[float] = field(default_factory=list)
     hol_stall: float = 0.0
+    # chunked prefill (ISSUE 19): True while the row's prompt is mid-way
+    # through interleaved prefill chunks — it holds a program row (its
+    # pages are reserved and partially written) but is device-dead, takes
+    # no decode dispatches, and is excluded from HOL-victim accounting
+    # until its final chunk samples the first token
+    prefilling: bool = False
+    # prefill dispatches a CHUNKED row's prompt took (intermediates + the
+    # final admit); stays 0 for a monolithic prefill — short suffix or
+    # KUBEML_PREFILL_CHUNK_TOKENS=0
+    prefill_chunks: int = 0
 
 
 @dataclass
@@ -299,7 +309,11 @@ class _Entry:
                 # decode-seconds its rows lost behind colocated prefill
                 "itl_p99": _itl_quantile(self.rows, 0.99),
                 "itl_max": _itl_quantile(self.rows, 1.0),
-                "hol_stall_seconds": sum(r.hol_stall for r in self.rows)}
+                "hol_stall_seconds": sum(r.hol_stall for r in self.rows),
+                # chunked prefill (ISSUE 19): prefill dispatches this
+                # request's prompts took beyond one — 0 means every row
+                # prefilled monolithically (short prompt or knob off)
+                "prefill_chunks": sum(r.prefill_chunks for r in self.rows)}
 
 
 def _itl_quantile(rows: List[_Row], q: float) -> float:
@@ -336,6 +350,25 @@ def _bucket_width(need: int, cap: int) -> int:
     while w < need:
         w *= 2
     return min(w, cap)
+
+
+def _chunk_cap(tokens: int, page_tokens: int) -> int:
+    """Resolve the ``KUBEML_PREFILL_CHUNK_TOKENS`` knob to the engine's
+    prefill-chunk cap: the largest power of two at most ``tokens``, floored
+    at one page. A pow2 at or above ``page_tokens`` (itself a pow2) is
+    always a whole number of pages, so every chunk boundary is
+    page-aligned — which is what keeps int8 KV quantization bit-identical
+    under chunking (a page's scale derives from exactly one chunk's
+    tokens) and the prefill-program set bounded (chunk programs land on
+    the same pow2 suffix-bucket keys the monolithic path compiles).
+    Returns 0 (chunking disabled — monolithic prefill, the parity oracle)
+    for a knob of 0 or anything below one page."""
+    if tokens < page_tokens:
+        return 0
+    cap = page_tokens
+    while cap * 2 <= tokens:
+        cap *= 2
+    return cap
 
 
 def _kv_token_bytes(module, layers: Optional[int] = None) -> int:
@@ -1768,7 +1801,8 @@ class PagedBatchingDecoder(BatchingDecoder):
                  spec_exit_layer: Optional[int] = None,
                  paged_attn: Optional[str] = None,
                  kv_quant: Optional[str] = None,
-                 spec_min_accept: Optional[float] = None, **kw):
+                 spec_min_accept: Optional[float] = None,
+                 prefill_chunk_tokens: Optional[int] = None, **kw):
         if mesh is not None:
             raise ValueError(
                 "paged serving does not run on a mesh yet; use the dense "
@@ -1980,6 +2014,20 @@ class PagedBatchingDecoder(BatchingDecoder):
         # zeroed rows point at the trash page, so a retired/canceled row's
         # stale device writes can never reach a reallocated page
         self._table = np.zeros((self.slots, self.table_pages), np.int32)
+        # --- chunked prefill (KUBEML_PREFILL_CHUNK_TOKENS, ISSUE 19):
+        # a cold prompt whose unshared suffix exceeds the cap advances one
+        # page-aligned chunk per engine-loop iteration through the same
+        # suffix-prefill program, interleaved with decode chunks, instead
+        # of one monolithic prefill stalling every decoding row. 0 = off.
+        self.prefill_chunk = _chunk_cap(
+            int(prefill_chunk_tokens if prefill_chunk_tokens is not None
+                else cfg.prefill_chunk_tokens), pt)
+        # rows mid-prefill: (slot, row) pairs holding program rows + leases
+        # whose prompts still have undispatched chunks; the turn flag
+        # alternates the last pipeline slot between a prefill chunk and a
+        # decode chunk when both contend for it
+        self._prefill_pending: List[tuple] = []
+        self._prefill_turn = True
 
     # --- capacity & programs ---
 
@@ -2176,7 +2224,8 @@ class PagedBatchingDecoder(BatchingDecoder):
                     * self._kv_draft_token_bytes)
         self._bump_pos_caps(k + 1)
         for row in self._slot_rows:
-            if row is not None and not row.done and not row.canceled:
+            if (row is not None and not row.done and not row.canceled
+                    and not row.prefilling):
                 # a live row emits AT LEAST one token per macro-step, so
                 # counting 1 keeps the dispatch gate conservative (the
                 # actual count lands with the results)
@@ -2190,9 +2239,31 @@ class PagedBatchingDecoder(BatchingDecoder):
             t0 = time.monotonic()
             return ("spec", np.asarray(rec[1]), np.asarray(rec[2]),
                     rec[3], rec[4], rec[5], rec[6], time.monotonic() - t0)
+        if rec[0] == "pchunk":
+            # the fetch is the dispatch's execution barrier, same as an
+            # admit record — the wall is what any stalled row lost
+            t0 = time.monotonic()
+            return ("pchunk", rec[1], np.asarray(rec[2]), rec[3], rec[4],
+                    rec[5], time.monotonic() - t0)
         return super()._materialize(rec)
 
     def _process_record(self, rec: tuple) -> None:
+        if rec[0] == "pchunk":
+            # an intermediate prefill chunk emits nothing and routes
+            # nothing; its accounting mirrors the admit branch (KV reads,
+            # HOL charge to the snapshot's stalled rows, cold-start
+            # quarantine) minus the token lifecycle
+            _, batch, _packed, kv_bytes, cold, stalled, fetch_s = rec
+            self._admits_inflight = max(0, self._admits_inflight - 1)
+            self.stats.kv_read(kv_bytes)
+            if fetch_s > 0 and stalled:
+                self.stats.hol_stall(fetch_s, len(stalled))
+                for r in stalled:
+                    r.hol_stall += fetch_s
+            if cold:
+                self.stats.cold_start(fetch_s)
+            self._warmed = True
+            return
         if rec[0] != "spec":
             return super()._process_record(rec)
         _, packed, stats_arr, snapshot, k, kv_bytes, cold, fetch_s = rec
@@ -2271,11 +2342,14 @@ class PagedBatchingDecoder(BatchingDecoder):
         return admits
 
     def _group_admits(self, admits: List[tuple]) -> List[List[tuple]]:
-        """Group by UNSHARED-SUFFIX length bucket (the prefill program's
-        shape) — a prefix hit's bucket shrinks with its suffix."""
+        """Group by UNPREFILLED-SUFFIX length bucket (the prefill
+        program's shape) — a prefix hit's bucket shrinks with its suffix,
+        and a chunked prefill's final chunk buckets by what its earlier
+        chunks left (``prefill_pos == prefix_tokens`` until a chunk moves
+        it, so monolithic admission is bit-identical to before)."""
         by_bucket: Dict[int, List[tuple]] = {}
         for slot, row in admits:
-            sfx = max(len(row.prompt) - row.lease.prefix_tokens, 1)
+            sfx = max(len(row.prompt) - row.lease.prefill_pos, 1)
             b = _pow2_bucket(sfx, self.bucket_min, self.max_len)
             by_bucket.setdefault(b, []).append((slot, row))
         return list(by_bucket.values())
@@ -2283,16 +2357,21 @@ class PagedBatchingDecoder(BatchingDecoder):
     def _stalled_rows(self) -> List[_Row]:
         """Paged flavor: undispatched work reads from the per-row
         ``dispatched`` accounting (a row `_retire_dispatched` already
-        drained mid-chunk left ``_slot_rows`` and is never charged)."""
+        drained mid-chunk left ``_slot_rows`` and is never charged).
+        Rows mid-chunked-prefill are NOT victims: they are not decoding
+        yet, so a colocated dispatch costs them nothing the chunking
+        didn't already choose (their own prefill latency is TTFT, tracked
+        separately)."""
         return [row for row in self._slot_rows
                 if row is not None and not row.done and not row.canceled
+                and not row.prefilling
                 and row.max_new - 1 - row.dispatched > 0]
 
     def _dispatch_admits(self, group: List[tuple]) -> tuple:
         n = len(group)
         k = self.slots
         bucket = _pow2_bucket(
-            max(max(len(r.prompt) - r.lease.prefix_tokens for _, r in group),
+            max(max(len(r.prompt) - r.lease.prefill_pos for _, r in group),
                 1), self.bucket_min, self.max_len)
         # HOL snapshot before the new rows take program rows (base class
         # comment applies: these are the rows this prefill delays)
@@ -2315,7 +2394,7 @@ class PagedBatchingDecoder(BatchingDecoder):
             max(-(-len(r.prompt) // pt) for _, r in group), self.table_pages)
         ptbl = np.zeros((k, wa), np.int32)
         for i, (slot, row) in enumerate(padded_group):
-            pre = row.lease.prefix_tokens
+            pre = row.lease.prefill_pos
             sfx = row.prompt[pre:]
             suffix[i, :len(sfx)] = sfx
             base[i] = pre
@@ -2351,9 +2430,12 @@ class PagedBatchingDecoder(BatchingDecoder):
             self._table[slot, :len(row.lease.pages)] = row.lease.pages
             row.dispatched = 0
             row.pos_cap = len(row.prompt)  # device cursor lands at plen
-            row.slot_at = now
-            self.stats.phase("queue_wait", now - row.entry.submitted_at)
-            real_tokens += len(row.prompt) - row.lease.prefix_tokens
+            if not row.slot_at:
+                # a chunked row took its slot (and paid queue_wait) at
+                # _begin_chunked_prefill; only monolithic admits land here
+                row.slot_at = now
+                self.stats.phase("queue_wait", now - row.entry.submitted_at)
+            real_tokens += len(row.prompt) - row.lease.prefill_pos
             # cache the FULL prompt blocks for future sharers. At dispatch
             # time, not admission: device programs run in dispatch order,
             # so a later match is guaranteed to read pages already written
@@ -2377,12 +2459,165 @@ class PagedBatchingDecoder(BatchingDecoder):
         self._admits_inflight += 1
         return ("admit", group, packed, kv_bytes, cold, stalled)
 
+    # --- chunked prefill (Sarathi-style, interleaved with decode) ---
+
+    def _begin_chunked_prefill(self, slot: int, row: _Row) -> None:
+        """Divert an admitted long-prompt row into the chunked-prefill
+        ledger: it takes its program row and pages NOW (admission
+        invariants unchanged — the lease was reserved worst-case), but
+        its ``_table`` row stays ZEROED until the final chunk, so the
+        frozen dead slab row's decode-step writes trash-redirect while
+        each prefill dispatch ships the real pages in its own clamped
+        table. The row keeps ``_busy()`` true via ``_slot_rows``."""
+        now = time.monotonic()
+        row.prefilling = True
+        row.dispatched = 0
+        row.pos_cap = row.lease.prefill_pos
+        row.slot_at = now
+        self.stats.phase("queue_wait", now - row.entry.submitted_at)
+        self._slot_rows[slot] = row
+        self._prefill_pending.append((slot, row))
+
+    def _advance_prefills(self, pool, next_seq: int,
+                          process_seq: int) -> tuple:
+        """One engine-loop turn of the chunked-prefill schedule: every
+        pending row advances AT MOST one chunk per iteration — rows whose
+        remaining suffix fits a chunk run REAL admission (first token,
+        sampling state, prefix registration: byte-identical to a
+        monolithic admit at that cursor), the rest advance one
+        intermediate chunk in a single batched dispatch. Decode chunks
+        dispatch in the same iteration, which is the whole point: a long
+        prompt no longer monopolizes the device for its full length.
+        Returns (next_seq, dispatched_anything)."""
+        if not self._prefill_pending:
+            return next_seq, False
+        cap = self.prefill_chunk
+        finals: List[tuple] = []
+        chunkable: List[tuple] = []
+        keep: List[tuple] = []
+        for slot, row in self._prefill_pending:
+            if row.done or row.canceled:
+                continue  # _evict_canceled owned the slot + lease
+            if len(row.prompt) - row.lease.prefill_pos <= cap:
+                finals.append((slot, row))
+            else:
+                chunkable.append((slot, row))
+        dispatched = False
+        for group in self._group_admits(finals):
+            if next_seq - process_seq >= self.pipeline_depth:
+                keep.extend(group)
+                continue
+            rec = self._dispatch_admits(group)
+            # clear ``prefilling`` only AFTER the dispatch: its internal
+            # _stalled_rows snapshot must not count a final-chunk row as
+            # its own head-of-line victim
+            n_tok = 0
+            for _, row in group:
+                row.prefilling = False
+                row.prefill_chunks += 1
+                n_tok += len(row.prompt) - row.lease.prefill_pos
+            self.stats.prefill_chunk(len(group), n_tok)
+            pool.submit(next_seq, rec)
+            next_seq += 1
+            dispatched = True
+        if chunkable:
+            if next_seq - process_seq < self.pipeline_depth:
+                pool.submit(next_seq,
+                            self._dispatch_prefill_chunk(chunkable))
+                next_seq += 1
+                dispatched = True
+            keep.extend(chunkable)
+        self._prefill_pending = keep
+        return next_seq, dispatched
+
+    def _dispatch_prefill_chunk(self, batch: List[tuple]) -> tuple:
+        """One page-aligned intermediate chunk for every mid-prefill row,
+        batched into a single suffix-prefill dispatch (SAME program as
+        admission — keyed ("prefill", (bucket, wa)), so chunking adds no
+        new XLA programs beyond the widths it exercises). ``max_new=1``
+        turns the program's admission scatter into a frozen dead row
+        (live0 False, remaining 0): the chunk writes its cap tokens of
+        K/V into the row's own pages and parks; the FINAL chunk re-runs
+        real admission with the row's own key/temp/topk/eos, overwriting
+        every placeholder — which is why the PRNG chain and sampled
+        tokens are bit-identical to monolithic prefill. Chunks are whole
+        pages (``_chunk_cap`` floors at page_tokens), so each arena page
+        — and each int8 page's scatter-max scale — derives from exactly
+        one dispatch's tokens, monolithic or chunked."""
+        cap = self.prefill_chunk
+        n = len(batch)
+        k = self.slots
+        bucket = _pow2_bucket(cap, self.bucket_min, self.max_len)
+        stalled = self._stalled_rows()
+        padded = batch + [batch[-1]] * (k - n)
+        suffix = np.zeros((k, bucket), np.int32)
+        base = np.zeros((k,), np.int32)
+        slens = np.ones((k,), np.int32)
+        rowids = np.zeros((k,), np.int32)
+        max_news = np.ones((k,), np.int32)  # 1 => dead scatter, no emission
+        temps = np.zeros((k,), np.float32)
+        topks = np.zeros((k,), np.int32)
+        eoss = np.full((k,), -1, np.int32)
+        keys = np.zeros((k, 2), np.uint32)
+        pt = self.page_tokens
+        wa = _bucket_width(
+            max(-(-(r.lease.prefill_pos + cap) // pt) for _, r in batch),
+            self.table_pages)
+        ptbl = np.zeros((k, wa), np.int32)
+        for i, (slot, row) in enumerate(padded):
+            pre = row.lease.prefill_pos
+            suffix[i, :cap] = row.prompt[pre:pre + cap]
+            base[i] = pre
+            slens[i] = cap
+            rowids[i] = slot
+            pgs = row.lease.pages[:wa]
+            ptbl[i, :len(pgs)] = pgs
+        args = (jnp.asarray(ptbl), jnp.asarray(suffix), jnp.asarray(base),
+                jnp.asarray(slens), jnp.asarray(rowids),
+                jnp.asarray(max_news), jnp.asarray(temps),
+                jnp.asarray(topks), jnp.asarray(eoss), jnp.asarray(keys))
+        if self.spec == "draft":
+            # the drafter's arena must hold the chunk's K/V too, or the
+            # final chunk's draft prefill would leave a gap
+            (self._slab, self._draft_cache, packed), cold = \
+                self._run_program(
+                    "prefill", (bucket, wa), self._prefill_admit,
+                    self._variables, self._draft_variables,
+                    self._draft_cache, self._slab, *args)
+        else:
+            (self._slab, packed), cold = self._run_program(
+                "prefill", (bucket, wa), self._prefill_admit,
+                self._variables, self._slab, *args)
+        for slot, row in batch:
+            row.lease.prefill_pos += cap
+            row.pos_cap = row.lease.prefill_pos
+            row.prefill_chunks += 1
+        real = n * cap
+        self.stats.admit_tokens(real, k * bucket - real)
+        self.stats.prefill_chunk(n, real)
+        # KV model mirrors _dispatch_admits at the chunk's (advanced)
+        # depth; no admitted_wave / register_prefix — those belong to the
+        # final chunk's real admission
+        if self.paged_attn == "pallas":
+            span = sum(min(-(-r.lease.prefill_pos // pt), wa) * pt
+                       for _, r in padded)
+        else:
+            span = k * wa * pt
+        kv_bytes = span * self._kv_token_bytes
+        if self.spec == "draft":
+            kv_bytes += span * self._kv_draft_token_bytes
+        self._admits_inflight += 1
+        return ("pchunk", batch, packed, kv_bytes, cold, stalled)
+
     # --- the decode chunk (pow2 ladder to the earliest completion) ---
 
     def _paged_chunk_size(self) -> int:
+        # rows mid-chunked-prefill hold a program row but have no decode
+        # work yet — they neither demand a chunk nor bound its size
         rem = [row.max_new - 1 - row.dispatched
                for row in self._slot_rows
                if row is not None and not row.done and not row.canceled
+               and not row.prefilling
                and row.max_new - 1 - row.dispatched > 0]
         if not rem:
             return 0
@@ -2413,7 +2648,10 @@ class PagedBatchingDecoder(BatchingDecoder):
         pt = self.page_tokens
         need = 1
         for row in self._slot_rows:
-            if row is None or row.lease is None:
+            if row is None or row.lease is None or row.prefilling:
+                # a prefilling row's table row is still zeroed (its pages
+                # ship per prefill dispatch) — its dead slab cursor walks
+                # the trash page and must not widen the decode table
                 continue
             need = max(need, min(-(-(row.pos_cap + extra) // pt),
                                  len(row.lease.pages)))
@@ -2425,7 +2663,8 @@ class PagedBatchingDecoder(BatchingDecoder):
         spec macro-step at most k+1, and no row ever writes past its final
         position (the device clamps via remaining/live)."""
         for row in self._slot_rows:
-            if row is not None and not row.done and not row.canceled:
+            if (row is not None and not row.done and not row.canceled
+                    and not row.prefilling):
                 row.pos_cap = min(row.pos_cap + adv,
                                   len(row.prompt) + row.max_new - 1)
 
@@ -2443,7 +2682,7 @@ class PagedBatchingDecoder(BatchingDecoder):
             return self.slots * w * pt
         total = 0
         for row in self._slot_rows:
-            if row is None or row.lease is None:
+            if row is None or row.lease is None or row.prefilling:
                 continue
             total += min(-(-(row.pos_cap + adv) // pt), w) * pt
         return total
@@ -2467,7 +2706,8 @@ class PagedBatchingDecoder(BatchingDecoder):
                        for s in range(1, size + 1)) * self._kv_token_bytes
         self._bump_pos_caps(size)
         for row in self._slot_rows:
-            if row is not None and not row.done and not row.canceled:
+            if (row is not None and not row.done and not row.canceled
+                    and not row.prefilling):
                 row.dispatched += size
         self.stats.chunk()
         return ("chunk", packed, list(self._slot_rows), kv_bytes, cold,
@@ -2481,7 +2721,10 @@ class PagedBatchingDecoder(BatchingDecoder):
         still in flight route through per-dispatch snapshots; the row waits
         in ``_draining`` only for its waiter bookkeeping."""
         for slot, row in enumerate(self._slot_rows):
-            if row is None or row.done or row.canceled:
+            if row is None or row.done or row.canceled or row.prefilling:
+                # a mid-prefill row with max_new == 1 reads as fully
+                # dispatched (0 >= 0) but hasn't emitted its first token —
+                # its final chunk clears ``prefilling`` and retires it then
                 continue
             if row.dispatched >= row.max_new - 1:
                 row.drained = True
@@ -2534,6 +2777,10 @@ class PagedBatchingDecoder(BatchingDecoder):
         # arena storage mode (1 = int8-quantized pages, 0 = compute dtype)
         # — pairs with pages_total so the capacity doubling is chartable
         snap["kv_quant"] = 1.0 if self.kv_quant == "int8" else 0.0
+        # rows currently mid-chunked-prefill (holding a slot + pages but
+        # not yet decoding) — the engine-thread snapshot is racy by a loop
+        # iteration, which is fine for a gauge
+        snap["prefills_in_progress"] = float(len(self._prefill_pending))
         if self._spec_ctl is not None:
             # current adaptive speculation depth (0 = retreated to plain
             # decode) + the controller's EWMA acceptance estimate
@@ -2584,12 +2831,35 @@ class PagedBatchingDecoder(BatchingDecoder):
                         with self._cond:
                             self._free.append(slot)
                         continue
+                    if (self.prefill_chunk and len(row.prompt)
+                            - row.lease.prefill_pos > self.prefill_chunk):
+                        # long cold suffix: prefill in page-aligned chunks
+                        # interleaved with decode instead of one program
+                        self._begin_chunked_prefill(slot, row)
+                        continue
                     live_admits.append((slot, row))
                 for group in self._group_admits(live_admits):
                     pool.submit(next_seq, self._dispatch_admits(group))
                     next_seq += 1
                     dispatched = True
                 self._evict_canceled()
+                # fair interleave (ISSUE 19): when the pipeline has room
+                # for only ONE dispatch and both a prefill chunk and a
+                # decode chunk want it, alternate the grant — prefill-
+                # first would re-create the monopoly chunking exists to
+                # break (live rows starve for the whole prompt, just in
+                # slices), decode-first would starve TTFT instead
+                prefill_now = True
+                if (self._prefill_pending
+                        and self.pipeline_depth
+                        - (next_seq - process_seq) == 1
+                        and self._paged_chunk_size() > 0):
+                    prefill_now = self._prefill_turn
+                    self._prefill_turn = not self._prefill_turn
+                if prefill_now:
+                    next_seq, adv = self._advance_prefills(
+                        pool, next_seq, process_seq)
+                    dispatched = dispatched or adv
                 self._retire_dispatched()
                 if (next_seq - process_seq < self.pipeline_depth
                         and (size := self._paged_chunk_size()) > 0):
@@ -2628,6 +2898,8 @@ class PagedBatchingDecoder(BatchingDecoder):
                     self._slot_rows = [None] * self.slots
                     self._free = list(range(self.slots))
                     self._admits_inflight = 0
+                    self._prefill_pending = []
+                    self._prefill_turn = True
                 try:
                     self._reset_engine_state()
                     self._slab = self._init_slab()

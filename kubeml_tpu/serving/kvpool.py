@@ -57,6 +57,12 @@ class PageLease:
     shared: int = 0          # leading pages refcount-shared via the trie
     prefix_tokens: int = 0   # prompt tokens those shared pages cover
     released: bool = False
+    # prefill-progress cursor (chunked prefill): prompt tokens whose K/V is
+    # already in the arena — prefix_tokens at admission, advanced one
+    # page-aligned chunk per engine-loop iteration until the final chunk's
+    # dispatch samples the first token. Monolithic prefill never moves it,
+    # so prefill_pos == prefix_tokens is the knob-off identity.
+    prefill_pos: int = 0
 
 
 class _TrieNode:
@@ -305,8 +311,9 @@ class KVPool:
             for p in shared:
                 self._release_one(p)
             return None
+        pre = len(shared) * self.page_tokens
         return PageLease(pages=shared + fresh, shared=len(shared),
-                         prefix_tokens=len(shared) * self.page_tokens)
+                         prefix_tokens=pre, prefill_pos=pre)
 
     def register_prefix(self, prompt: Sequence[int], lease: PageLease) -> None:
         """Cache a just-dispatched prefill's full prompt blocks for future

@@ -124,6 +124,13 @@ class DecoderStats:
         # work (admission or long suffix-prefill) — seconds x stalled rows,
         # the direct evidence counter for chunked prefill / disaggregation
         self.hol_stall_seconds = 0.0
+        # chunked prefill (ISSUE 19): prefill dispatches that were chunks
+        # of a long prompt (intermediates AND the final admission chunk of
+        # a chunked row), and the prompt tokens those chunks covered —
+        # monolithic admissions bump neither, so nonzero means the
+        # KUBEML_PREFILL_CHUNK_TOKENS path actually ran
+        self.prefill_chunks = 0
+        self.prefill_chunk_tokens = 0
         # compile tracker (ISSUE 18): distinct traced XLA programs keyed by
         # (program label, shape signature); per-label compile counts; the
         # storm threshold is set by the engine from config (compiles/min
@@ -310,6 +317,18 @@ class DecoderStats:
         with self._lock:
             self.hol_stall_seconds += float(seconds) * int(rows)
 
+    def prefill_chunk(self, rows: int, tokens: int) -> None:
+        """One chunked-prefill dispatch advanced ``rows`` mid-prefill rows
+        by ``tokens`` real prompt tokens total (each row counts one chunk;
+        the final chunk of a chunked row counts here too). Token totals
+        ride :meth:`admit_tokens` as usual — this pair isolates how much
+        prefill ran chunked."""
+        if rows <= 0:
+            return
+        with self._lock:
+            self.prefill_chunks += int(rows)
+            self.prefill_chunk_tokens += int(tokens)
+
     def cold_start(self, seconds: float) -> None:
         """A first-call (trace+compile) wall observed outside the decode
         path — admission or spec programs — lands in the cold series."""
@@ -495,6 +514,8 @@ class DecoderStats:
                     self.fetchers_inflight / self.fetchers_total
                     if self.fetchers_total else 0.0),
                 "hol_stall_seconds": float(self.hol_stall_seconds),
+                "prefill_chunks": float(self.prefill_chunks),
+                "prefill_chunk_tokens": float(self.prefill_chunk_tokens),
                 "compiled_programs": float(len(self._compiled)),
             }
             compiles_per_min = self._compile_series.rate(

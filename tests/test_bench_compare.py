@@ -126,12 +126,43 @@ def test_spec_decode_rows_gate_tokens_per_step_and_acceptance(tmp_path):
     assert _run(files["base"], files["good"]).returncode == 0
 
 
+def test_hol_stall_rows_gate_lower_is_better(tmp_path):
+    """Chunked-prefill rows (ISSUE 19): head-of-line stall seconds per
+    completed request is lower-is-better — a candidate whose chunking
+    regresses (MORE stall per request) fails the gate; the measured
+    improvement the demo records passes it."""
+    base = {"metric": "chunked-prefill", "value": 1000.0,
+            "hol_stall_seconds_per_request": 0.40}
+    worse = {**base, "hol_stall_seconds_per_request": 0.55}   # +38% stall
+    better = {**base, "hol_stall_seconds_per_request": 0.10}  # -75% stall
+    files = {}
+    for name, row in (("base", base), ("worse", worse), ("better", better)):
+        f = tmp_path / f"{name}.json"
+        f.write_text(json.dumps(row))
+        files[name] = f
+    p = _run(files["base"], files["worse"])
+    assert p.returncode == 1
+    report = json.loads(p.stdout)
+    assert (report["regressions"][0]["metric"]
+            == "serving_hol_stall_per_request")
+    assert "lower-is-better" in report["regressions"][0]["detail"]
+    assert _run(files["base"], files["better"]).returncode == 0
+    # rows without the field (train benches) skip the metric, not fail
+    f = tmp_path / "plain.json"
+    f.write_text(json.dumps({"metric": "m", "value": 1000.0}))
+    p = _run(f, f)
+    assert p.returncode == 0
+    assert any(s["metric"] == "serving_hol_stall_per_request"
+               for s in json.loads(p.stdout)["skipped"])
+
+
 def test_metric_direction_table():
     from kubeml_tpu.benchmarks.harness import GATE_METRICS, metric_direction
 
     assert metric_direction("spec_tokens_per_step") == "higher"
     assert metric_direction("spec_accept_ratio") == "higher"
     assert metric_direction("serving_latency_p95_ms") == "lower"
+    assert metric_direction("serving_hol_stall_per_request") == "lower"
     assert all(d in ("higher", "lower")
                for _f, d in GATE_METRICS.values())
 

@@ -1,0 +1,385 @@
+"""Chunked prefill (ISSUE 19): page-aligned prompt chunks interleaved with
+decode. Correctness bars:
+
+* TOKEN PARITY — ``KUBEML_PREFILL_CHUNK_TOKENS=N`` must be invisible in the
+  emitted tokens: greedy AND seeded sampling, cold prompts AND prefix-trie
+  hits, plain decode AND speculative self-drafting AND int8 KV pages, all
+  bit-identical to the monolithic (knob=0) engine — which is itself held
+  token-identical to the one-shot baseline by the PR-12 suite.
+* KNOB=0 IS MONOLITHIC — chunking disabled takes the exact pre-chunking
+  code path: zero chunk counters, zero payload chunks, no pending ledger.
+* ALLOCATOR EXACTNESS MID-PREFILL — a row canceled between its chunks
+  returns every page exactly once (``KVPool.check``), and the engine
+  drains with a clean slot table and an empty prefill ledger.
+* NO KERNEL CHANGE — chunking is pure host-side scheduling: the model's
+  paged suffix-prefill apply, run as two page-aligned chunks at non-zero
+  bases, produces the same logits and the same arena as one monolithic
+  apply (unit-level proof that the device program needed no new math).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubeml_tpu.api.types import GenerateRequest
+from kubeml_tpu.models.generation import generate, init_paged_cache
+from kubeml_tpu.models.gpt import CausalTransformer
+from kubeml_tpu.serving.batcher import (PagedBatchingDecoder, _Row,
+                                        _chunk_cap)
+from kubeml_tpu.serving.kvpool import KVPool
+
+VOCAB = 101
+
+
+def tiny(pos="learned", max_len=96):
+    return CausalTransformer(vocab_size=VOCAB, max_len=max_len, embed_dim=64,
+                             depth=2, num_heads=4, pos=pos)
+
+
+@pytest.fixture(scope="module")
+def served():
+    m = tiny()
+    variables = m.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    return m, variables
+
+
+def one_shot(m, variables, prompt, n, **kw):
+    out = generate(m, variables, np.asarray(prompt, np.int32),
+                   max_new_tokens=n, **kw)
+    return np.asarray(out.tokens), np.asarray(out.lengths)
+
+
+def drive(dec, prompts, max_news, **kw):
+    entries = [dec.submit(GenerateRequest(prompts=p.tolist(),
+                                          max_new_tokens=n, **kw))
+               for p, n in zip(prompts, max_news)]
+    return [dec.wait(e, timeout=600) for e in entries]
+
+
+# --- host units (no device work) ---
+
+
+def test_chunk_cap_resolution():
+    """The knob resolves to the largest pow2 at most its value, floored at
+    one page — 0 (monolithic) below that. Every non-zero cap is a whole
+    number of pages, so chunk boundaries stay page-aligned."""
+    assert _chunk_cap(0, 4) == 0
+    assert _chunk_cap(3, 4) == 0          # below one page: disabled
+    assert _chunk_cap(4, 4) == 4
+    assert _chunk_cap(7, 4) == 4
+    assert _chunk_cap(8, 4) == 8
+    assert _chunk_cap(100, 4) == 64
+    assert _chunk_cap(8, 8) == 8
+    assert _chunk_cap(100, 8) == 64
+    assert _chunk_cap(7, 8) == 0
+    for tokens in range(4, 200):
+        cap = _chunk_cap(tokens, 4)
+        assert cap % 4 == 0 and cap <= tokens
+        assert cap & (cap - 1) == 0       # pow2 -> shared program buckets
+
+
+def test_lease_prefill_pos_starts_at_prefix_cursor():
+    """A fresh lease's chunk cursor sits exactly at the trie-shared token
+    count: cold admits prefill from 0, prefix hits from the shared pages'
+    end — both page-aligned by construction."""
+    pool = KVPool(33, 4)
+    prompt = np.arange(1, 14)
+    a = pool.admit(prompt, 4)
+    assert a.prefill_pos == a.prefix_tokens == 0
+    pool.register_prefix(prompt, a)
+    b = pool.admit(prompt, 4)
+    assert b.shared == 3
+    assert b.prefill_pos == b.prefix_tokens == 12
+    assert b.prefill_pos % pool.page_tokens == 0
+    for lease in (a, b):
+        pool.release(lease)
+    pool.trie.flush()
+
+
+def test_stalled_rows_exclude_prefilling_and_drained():
+    """HOL-victim accounting: a mid-chunk prefilling row is NOT a victim
+    (it is not decoding yet), and neither is a row whose work already
+    fully dispatched — only live rows with undispatched decode steps."""
+    def row(**kw):
+        r = _Row(entry=None, index=0, prompt=np.arange(1, 5, dtype=np.int32),
+                 max_new=8, temp=0.0, topk=0, eos=-1,
+                 key=np.zeros(2, np.uint32))
+        for k, v in kw.items():
+            setattr(r, k, v)
+        return r
+
+    victim = row(dispatched=2)
+    dec = object.__new__(PagedBatchingDecoder)
+    dec._slot_rows = [
+        victim,
+        row(prefilling=True),              # mid-chunk: excluded
+        row(done=True),                    # finished: excluded
+        row(canceled=True),                # abandoned: excluded
+        row(dispatched=7),                 # max_new-1 already in chain
+        None,                              # empty slot
+    ]
+    assert PagedBatchingDecoder._stalled_rows(dec) == [victim]
+
+
+# --- model level: chunking needs no kernel change ---
+
+
+@pytest.mark.slow
+def test_module_chunked_prefill_applies_match_monolithic():
+    """Two page-aligned suffix-prefill applies (base 0 then base 8) must
+    leave the same arena and produce the same last-token logits as one
+    monolithic apply — chunking is host scheduling only; the device
+    program is the unmodified suffix-prefill at a non-zero base that the
+    prefix-cache path already compiles."""
+    m = tiny(max_len=32)
+    variables = m.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.int32))
+    pt, tp = 4, 8
+    mod = m.clone(page_tokens=pt, kv_pages=2 * tp + 1, paged_attn="gather")
+    prompt = np.arange(1, 13, dtype=np.int32)[None]  # plen 12 = 8 + 4
+    table = jnp.asarray([[1 + j for j in range(tp)]], jnp.int32)
+
+    def prefill(cache, toks, base):
+        logits, vs = mod.apply(
+            {**variables, "cache": cache}, jnp.asarray(toks), decode=True,
+            positions=jnp.asarray([base], jnp.int32), pages=table,
+            seq_lens=jnp.asarray([toks.shape[1]], jnp.int32),
+            mutable=["cache"])
+        return np.asarray(logits[:, -1]), vs["cache"]
+
+    mono_logits, mono_cache = prefill(
+        init_paged_cache(mod, variables, 1, tp), prompt, 0)
+    _, cache = prefill(init_paged_cache(mod, variables, 1, tp),
+                       prompt[:, :8], 0)
+    chunk_logits, chunk_cache = prefill(cache, prompt[:, 8:], 8)
+    np.testing.assert_array_equal(chunk_logits, mono_logits)
+    jax.tree.map(np.testing.assert_array_equal, chunk_cache, mono_cache)
+
+
+# --- engine parity (device work: slow tier) ---
+
+
+@pytest.mark.slow
+@pytest.mark.paged
+def test_chunked_greedy_parity_and_counters(served):
+    """Cold long prompts chunk through interleaved prefill while short
+    prompts decode; every row stays one-shot-identical, payloads report
+    the per-request chunk count, and the chunk counters account exactly
+    the chunked rows' suffix tokens."""
+    m, variables = served
+    rng = np.random.default_rng(19)
+    longs = [rng.integers(1, VOCAB, size=(1, l)).astype(np.int32)
+             for l in (50, 41)]
+    shorts = [rng.integers(1, VOCAB, size=(1, l)).astype(np.int32)
+              for l in (5, 9)]
+    prompts = [longs[0], shorts[0], longs[1], shorts[1]]
+    max_news = [8, 10, 6, 7]
+    refs = [one_shot(m, variables, p, n)[0][0].tolist()
+            for p, n in zip(prompts, max_news)]
+    dec = PagedBatchingDecoder(m, variables, slots=3, chunk_steps=4,
+                               page_tokens=4, prefill_chunk_tokens=16)
+    try:
+        assert dec.prefill_chunk == 16
+        results = drive(dec, prompts, max_news)
+        for r, ref in zip(results, refs):
+            assert r["tokens"][0] == ref
+        # payload: chunked rows report their dispatch count, short rows 0
+        assert results[0]["prefill_chunks"] == 4   # 16+16+16 + final 2
+        assert results[2]["prefill_chunks"] == 3   # 16+16 + final 9
+        assert results[1]["prefill_chunks"] == 0
+        assert results[3]["prefill_chunks"] == 0
+        snap = dec.stats.snapshot()
+        assert snap["prefill_chunks"] == 7.0
+        assert snap["prefill_chunk_tokens"] == float(50 + 41)
+        t = dec.telemetry()
+        assert t["prefills_in_progress"] == 0.0
+        assert (t["live_slot_steps"] + t["dead_slot_steps"]
+                + t["idle_slot_steps"]) == t["slot_steps"]
+        chk = dec._pool.check()
+        assert chk["held"] == chk["trie_pages"]
+    finally:
+        dec.close()
+
+
+@pytest.mark.slow
+@pytest.mark.paged
+def test_chunked_seeded_sampling_bit_identical(served):
+    """The final chunk re-runs real admission with the row's own key, so
+    the per-row key-split chain — and every sampled token — is
+    bit-identical to monolithic prefill."""
+    m, variables = served
+    p = np.random.default_rng(7).integers(1, VOCAB, size=(1, 44)).astype(
+        np.int32)
+    outs = []
+    for knob in (0, 16):
+        dec = PagedBatchingDecoder(m, variables, slots=2, chunk_steps=4,
+                                   page_tokens=4, prefill_chunk_tokens=knob)
+        try:
+            outs.append(dec.wait(dec.submit(GenerateRequest(
+                prompts=p.tolist(), max_new_tokens=9, temperature=0.8,
+                top_k=7, seed=42)), timeout=600))
+        finally:
+            dec.close()
+    assert outs[0]["tokens"] == outs[1]["tokens"]
+    assert outs[0]["lengths"] == outs[1]["lengths"]
+    assert outs[0]["prefill_chunks"] == 0 and outs[1]["prefill_chunks"] == 3
+
+
+@pytest.mark.slow
+@pytest.mark.paged
+def test_chunked_prefix_hit_starts_at_shared_cursor(served):
+    """A prefix-trie hit chunks only its suffix: the cursor starts at the
+    shared pages' end (page-aligned), the payload still reports the
+    cached tokens, and the emitted tokens stay one-shot-identical."""
+    m, variables = served
+    rng = np.random.default_rng(5)
+    sysp = rng.integers(1, VOCAB, size=24).astype(np.int32)
+    p1 = np.concatenate([sysp, rng.integers(1, VOCAB, size=9).astype(np.int32)])
+    p2 = np.concatenate([sysp, rng.integers(1, VOCAB, size=29).astype(np.int32)])
+    dec = PagedBatchingDecoder(m, variables, slots=2, chunk_steps=4,
+                               page_tokens=4, prefill_chunk_tokens=8)
+    try:
+        r1 = dec.wait(dec.submit(GenerateRequest(prompts=[p1.tolist()],
+                                                 max_new_tokens=6)),
+                      timeout=600)
+        r2 = dec.wait(dec.submit(GenerateRequest(prompts=[p2.tolist()],
+                                                 max_new_tokens=6)),
+                      timeout=600)
+        assert r1["tokens"][0] == one_shot(m, variables, p1[None],
+                                           6)[0][0].tolist()
+        assert r2["tokens"][0] == one_shot(m, variables, p2[None],
+                                           6)[0][0].tolist()
+        assert r2["prefix_cached_tokens"] == 24  # 6 full pages of 4
+        # suffix 53-24=29 chunks as 8+8+8 + final 5
+        assert r2["prefill_chunks"] == 4
+    finally:
+        dec.close()
+
+
+@pytest.mark.slow
+@pytest.mark.paged
+@pytest.mark.spec
+def test_chunked_spec_self_draft_parity(served):
+    """Speculative self-drafting composes with chunked prefill: the final
+    chunk's admission also primes the draft cache, so chunked-vs-
+    monolithic greedy parity must survive spec mode."""
+    m, variables = served
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, VOCAB, size=(1, l)).astype(np.int32)
+               for l in (45, 7)]
+    max_news = [8, 6]
+    outs = {}
+    for knob in (0, 16):
+        dec = PagedBatchingDecoder(m, variables, slots=2, chunk_steps=4,
+                                   page_tokens=4, prefill_chunk_tokens=knob,
+                                   spec="self", spec_k=2, spec_adaptive=False,
+                                   spec_exit_layer=1)
+        try:
+            outs[knob] = [r["tokens"][0]
+                          for r in drive(dec, prompts, max_news)]
+        finally:
+            dec.close()
+    assert outs[0] == outs[16]
+
+
+@pytest.mark.slow
+@pytest.mark.paged
+def test_chunked_int8_kv_bit_identical(served):
+    """Chunks are whole pages, so each int8 page's scatter-max scale
+    derives from exactly one dispatch's tokens — chunked and monolithic
+    quantized arenas round identically and tokens match bit-for-bit."""
+    m, variables = served
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, VOCAB, size=(1, l)).astype(np.int32)
+               for l in (42, 6)]
+    max_news = [7, 9]
+    outs = {}
+    for knob in (0, 16):
+        dec = PagedBatchingDecoder(m, variables, slots=2, chunk_steps=4,
+                                   page_tokens=4, pages=41, kv_quant="int8",
+                                   prefill_chunk_tokens=knob)
+        try:
+            outs[knob] = [r["tokens"][0]
+                          for r in drive(dec, prompts, max_news)]
+        finally:
+            dec.close()
+    assert outs[0] == outs[16]
+
+
+@pytest.mark.slow
+@pytest.mark.paged
+def test_knob_zero_takes_monolithic_path(served):
+    """Chunking disabled is byte-for-byte the pre-chunking engine: long
+    prompts admit monolithically, every chunk counter stays zero and the
+    pending ledger never populates."""
+    m, variables = served
+    p = np.random.default_rng(2).integers(1, VOCAB, size=(1, 40)).astype(
+        np.int32)
+    dec = PagedBatchingDecoder(m, variables, slots=2, chunk_steps=4,
+                               page_tokens=4, prefill_chunk_tokens=0)
+    try:
+        assert dec.prefill_chunk == 0
+        r = dec.wait(dec.submit(GenerateRequest(prompts=p.tolist(),
+                                                max_new_tokens=6)),
+                     timeout=600)
+        assert r["tokens"][0] == one_shot(m, variables, p, 6)[0][0].tolist()
+        assert r["prefill_chunks"] == 0
+        snap = dec.stats.snapshot()
+        assert snap["prefill_chunks"] == 0.0
+        assert snap["prefill_chunk_tokens"] == 0.0
+        assert dec.telemetry()["prefills_in_progress"] == 0.0
+        assert dec._prefill_pending == []
+    finally:
+        dec.close()
+
+
+@pytest.mark.slow
+@pytest.mark.paged
+def test_mid_prefill_cancel_returns_pages_exactly_once(served):
+    """Cancel storms landing BETWEEN a row's chunks: the evicted row's
+    lease releases exactly once, the prefill ledger drops it the same
+    iteration, and at drain the trie is the only page holder with a clean
+    slot table."""
+    m, variables = served
+    dec = PagedBatchingDecoder(m, variables, slots=2, chunk_steps=4,
+                               page_tokens=4, pages=41,
+                               prefill_chunk_tokens=8)
+    rng = np.random.default_rng(23)
+    try:
+        for i in range(6):
+            p = rng.integers(1, VOCAB, size=(1, 40)).astype(np.int32)
+            e = dec.submit(GenerateRequest(prompts=p.tolist(),
+                                           max_new_tokens=8))
+            # land the cancel at varied points of the 5-chunk schedule
+            time.sleep(0.002 * i)
+            dec.cancel(e)
+        # a surviving request proves the engine still serves after storms
+        p = rng.integers(1, VOCAB, size=(1, 33)).astype(np.int32)
+        r = dec.wait(dec.submit(GenerateRequest(prompts=p.tolist(),
+                                                max_new_tokens=5)),
+                     timeout=600)
+        assert r["tokens"][0] == one_shot(m, variables, p, 5)[0][0].tolist()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with dec._cond:
+                idle = (not dec._pending and not dec._busy()
+                        and not dec._draining)
+            if idle:
+                break
+            time.sleep(0.05)
+        assert idle, "engine did not drain"
+        assert dec._prefill_pending == []
+        chk = dec._pool.check()  # raises on leak / double-free / overlap
+        assert chk["held"] == chk["trie_pages"]
+        dec._pool.trie.flush()
+        assert dec._pool.free_pages() == dec._pool.capacity
+        dec._pool.check()
+        with dec._cond:
+            assert sorted(dec._free) == [0, 1]
+            assert all(r is None for r in dec._slot_rows)
+    finally:
+        dec.close()

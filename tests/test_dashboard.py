@@ -66,6 +66,8 @@ def _exported_names() -> set:
     stats.inter_token(0.02)
     stats.hol_stall(0.1, 2)
     stats.cold_start(0.5)
+    # chunked prefill (ISSUE 19): chunk dispatch counters
+    stats.prefill_chunk(2, 48)
     if stats.compile_begin("step", (8,)):
         stats.compiled("step", 0.4)
     stats.chunk_occupancy(8, 20, 6, 6)
@@ -82,7 +84,7 @@ def _exported_names() -> set:
                  "slot_occupancy": 0.25, "weight_bytes": 1024.0,
                  "queue_limit": 16.0, "spec_k": 4.0,
                  "paged_attn_kernel": 1.0, "kv_quant": 1.0,
-                 "spec_disabled": 0.0})
+                 "spec_disabled": 0.0, "prefills_in_progress": 1.0})
     reg.set_serving_source(lambda: {"drift-model": snap})
     # SLO burn/state gauges
     reg.set_slo_source(lambda: {"burn": {("drift", "fast"): 0.5},
@@ -211,6 +213,18 @@ def test_latency_anatomy_panels_present():
                    "kubeml_serving_compile_seconds_bucket",
                    "kubeml_serving_cold_start_seconds_bucket"):
         assert metric in refs, f"no panel charts {metric}"
+
+
+def test_chunked_prefill_panels_present():
+    """The ISSUE-19 panels: chunk dispatch rate with the mid-prefill
+    prompt gauge, and chunked-prefill token throughput charted against
+    the head-of-line stall rate the knob exists to push down."""
+    refs = _dashboard_names()
+    for metric in ("kubeml_serving_prefill_chunks_total",
+                   "kubeml_serving_prefill_chunk_tokens_total",
+                   "kubeml_serving_prefills_in_progress"):
+        assert metric in refs, f"no panel charts {metric}"
+    assert "kubeml_serving_hol_stall_seconds_total" in refs
 
 
 # Exported metrics deliberately NOT charted — the reverse drift guard

@@ -459,6 +459,95 @@ def test_allocator_exactness_under_cancel_timeout_shed_chaos(served):
         dec.close()
 
 
+@pytest.mark.slow
+@pytest.mark.paged
+def test_allocator_chaos_storm_chunked_prefill():
+    """The chaos storm re-run with KUBEML_PREFILL_CHUNK_TOKENS=8 and long
+    prompts (16-40 tokens, some prefix-shared): cancels, timeouts and
+    deadline expiries now land BETWEEN a row's prefill chunks — while its
+    pages are reserved and partially written but the row is device-dead.
+    The exactness bar is unchanged: every page returned once, the trie
+    the only holder at drain, no slot leaked, the prefill ledger empty."""
+    from kubeml_tpu.utils import resilience
+
+    m = tiny()
+    variables = m.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    dec = PagedBatchingDecoder(m, variables, slots=3, chunk_steps=8,
+                               page_tokens=4, pages=61, queue_limit=6,
+                               shed_policy="oldest",
+                               prefill_chunk_tokens=8)
+    rng = np.random.default_rng(1919)
+    sysp = rng.integers(1, VOCAB, size=16).astype(np.int32)
+    errors = []
+
+    def client(i):
+        r = np.random.default_rng(2000 + i)
+        try:
+            for _ in range(3):
+                if r.random() < 0.4:
+                    prompt = np.concatenate(
+                        [sysp,
+                         r.integers(1, VOCAB, size=int(r.integers(4, 20)))])
+                else:
+                    prompt = r.integers(1, VOCAB, size=int(r.integers(16, 41)))
+                req = GenerateRequest(
+                    prompts=[prompt.astype(np.int32).tolist()],
+                    max_new_tokens=int(r.integers(2, 24)),
+                    temperature=0.7 if r.random() < 0.3 else 0.0,
+                    seed=int(r.integers(1, 1 << 30)))
+                roll = r.random()
+                try:
+                    if roll < 0.2:
+                        # deadline likely expires while queued or mid-chunk
+                        with resilience.bind_deadline(time.time() + 0.01):
+                            e = dec.submit(req)
+                        dec.wait(e, timeout=30)
+                    elif roll < 0.45:
+                        e = dec.submit(req)
+                        dec.wait(e, timeout=0.01)  # waiter gives up fast
+                    elif roll < 0.6:
+                        e = dec.submit(req)
+                        # sleeps sized to the multi-chunk prefill window so
+                        # cancels hit rows in every prefill_pos state
+                        time.sleep(float(r.random()) * 0.1)
+                        dec.cancel(e)
+                    else:
+                        e = dec.submit(req)
+                        dec.wait(e, timeout=600)
+                except KubeMLError:
+                    pass  # 429/504s are the point of the storm
+        except Exception as exc:  # pragma: no cover - surfaced below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        assert not errors
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with dec._cond:
+                idle = (not dec._pending and not dec._busy()
+                        and not dec._draining)
+            if idle:
+                break
+            time.sleep(0.05)
+        assert idle, "engine did not drain"
+        assert dec._prefill_pending == []
+        chk = dec._pool.check()  # raises on leak / double-free / overlap
+        assert chk["held"] == chk["trie_pages"]
+        dec._pool.trie.flush()
+        assert dec._pool.free_pages() == dec._pool.capacity
+        dec._pool.check()
+        with dec._cond:
+            assert sorted(dec._free) == [0, 1, 2]
+            assert all(r is None for r in dec._slot_rows)
+    finally:
+        dec.close()
+
+
 # --- stats: partition identity under variable capacity (satellite 6) ---
 
 

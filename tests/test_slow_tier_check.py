@@ -1,0 +1,112 @@
+"""Slow-tier drift guard's own seams (fast tier-1): the duration-log
+parser, the listing/explicit-mark resolution, and main()'s exit-code
+contract — 0 all tiered, 1 offenders, 2 unusable input. The guard is what
+keeps the quick tier inside its ~3-minute budget, so it gets the same
+drift protection it provides."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "scripts"))
+
+import slow_tier_check  # noqa: E402
+
+
+def _log(tmp_path, lines):
+    p = tmp_path / "durations.log"
+    p.write_text("\n".join(lines) + "\n")
+    return p
+
+
+def test_measured_slow_parses_durations_log(tmp_path):
+    log = _log(tmp_path, [
+        "tests/test_x.py::test_fast PASSED",
+        "  12.34s call     tests/test_x.py::test_heavy[param]",
+        "  0.50s call     tests/test_x.py::test_quick",
+        "  4.00s call     tests/test_y.py::test_at_threshold",
+        "  9.99s setup    tests/test_y.py::test_setup_only",
+        "  6.00s call     other/test_elsewhere.py::test_ignored",
+        r"  5.00s call     tests\test_win.py::test_backslashes",
+    ])
+    slow = slow_tier_check.measured_slow(log)
+    assert (4.0, "tests/test_y.py::test_at_threshold") in slow
+    assert (12.34, "tests/test_x.py::test_heavy[param]") in slow
+    # setup phases, sub-threshold calls and non-tests paths never count;
+    # windows separators normalize to the listing's forward slashes
+    assert (5.0, "tests/test_win.py::test_backslashes") in slow
+    assert len(slow) == 3
+
+
+def test_listed_ids_skips_comments_and_blanks():
+    ids = slow_tier_check.listed_ids()
+    assert ids, "tests/slow_tests.txt is empty?"
+    assert not any(i.startswith("#") for i in ids)
+    # the chunked-prefill chaos storm is explicitly marked, not listed;
+    # the PR-12 storm is listed — both conventions must keep working
+    assert ("tests/test_paged_serving.py::"
+            "test_allocator_exactness_under_cancel_timeout_shed_chaos"
+            "[learned]") in ids
+
+
+def test_explicitly_marked_resolves_source_decorations():
+    nodeids = [
+        (9.0, "tests/test_chunked_prefill.py::"
+              "test_chunked_greedy_parity_and_counters"),
+        (5.0, "tests/test_chunked_prefill.py::"
+              "test_module_chunked_prefill_applies_match_monolithic"),
+        (5.0, "tests/test_chunked_prefill.py::test_chunk_cap_resolution"),
+    ]
+    marked = slow_tier_check.explicitly_marked(nodeids)
+    assert nodeids[0][1] in marked
+    assert nodeids[1][1] in marked
+    assert nodeids[2][1] not in marked  # fast unit: no slow mark
+
+
+def test_main_exit_codes(tmp_path, capsys):
+    # 2: bad usage / missing log / no durations in the log
+    assert slow_tier_check.main(["prog"]) == 2
+    assert slow_tier_check.main(["prog", str(tmp_path / "nope.log")]) == 2
+    empty = _log(tmp_path, ["1 passed in 0.10s"])
+    assert slow_tier_check.main(["prog", str(empty)]) == 2
+
+    # 1: a measured-slow test neither listed nor marked
+    bad = _log(tmp_path, [
+        "  7.77s call     tests/test_x.py::test_unmarked_heavy"])
+    assert slow_tier_check.main(["prog", str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "test_unmarked_heavy" in out and "7.77" in out
+
+    # 0: everything slow is tiered out — via the listing or a source mark
+    ok = _log(tmp_path, [
+        "  8.00s call     tests/test_paged_serving.py::"
+        "test_allocator_exactness_under_cancel_timeout_shed_chaos[learned]",
+        "  6.00s call     tests/test_chunked_prefill.py::"
+        "test_chunked_int8_kv_bit_identical",
+    ])
+    assert slow_tier_check.main(["prog", str(ok)]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_new_chunked_tests_satisfy_the_guard(tmp_path):
+    """The PR-19 discipline check itself: every heavy chunked-prefill test
+    added this PR passes the guard through the explicit-mark path."""
+    heavy = [
+        "tests/test_chunked_prefill.py::test_chunked_greedy_parity_and_counters",
+        "tests/test_chunked_prefill.py::test_chunked_seeded_sampling_bit_identical",
+        "tests/test_chunked_prefill.py::test_chunked_prefix_hit_starts_at_shared_cursor",
+        "tests/test_chunked_prefill.py::test_chunked_spec_self_draft_parity",
+        "tests/test_chunked_prefill.py::test_chunked_int8_kv_bit_identical",
+        "tests/test_chunked_prefill.py::test_knob_zero_takes_monolithic_path",
+        "tests/test_chunked_prefill.py::test_mid_prefill_cancel_returns_pages_exactly_once",
+        "tests/test_chunked_prefill.py::test_module_chunked_prefill_applies_match_monolithic",
+        "tests/test_paged_serving.py::test_allocator_chaos_storm_chunked_prefill",
+    ]
+    log = _log(tmp_path, [f"  9.00s call     {n}" for n in heavy])
+    assert slow_tier_check.main(["prog", str(log)]) == 0
+
+
+def test_threshold_is_the_documented_bar():
+    assert slow_tier_check.THRESHOLD_S == pytest.approx(4.0)
