@@ -350,6 +350,24 @@ class Config:
     # and the chunked path's parity oracle.
     prefill_chunk_tokens: int = field(
         default_factory=lambda: _env_int("KUBEML_PREFILL_CHUNK_TOKENS", 0))
+    # graceful serving drain (ISSUE 20): seconds live rows get to run out
+    # after POST /serving/drain (or SIGTERM) before the engine snapshots
+    # stragglers into portable KMS1 frames and fails their waiters 503
+    drain_grace: float = field(
+        default_factory=lambda: float(
+            os.environ.get("KUBEML_DRAIN_GRACE", "20")))
+    # where drained request snapshots land (one <model>-<request>.kms per
+    # straggler) and where the PS looks for them on next boot to replay —
+    # empty (default) disables the cross-process snapshot hop entirely
+    snap_dir: str = field(
+        default_factory=lambda: os.environ.get("KUBEML_SNAP_DIR", ""))
+    # KVPool invariant watchdog: the paged engine runs kvpool.check()
+    # every this-many seconds under the engine lock; a tripped invariant
+    # fires the errorhook and routes through fault recovery instead of
+    # decoding through corrupted page accounting. 0 (default) = off
+    pool_audit_interval: float = field(
+        default_factory=lambda: float(
+            os.environ.get("KUBEML_POOL_AUDIT_INTERVAL", "0")))
     # how the paged engine READS the KV arena (ops/paged_attention.py):
     # "pallas" attends straight through the page table with the streaming
     # Pallas kernel (KV traffic scales with each row's actual depth, no

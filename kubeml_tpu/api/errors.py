@@ -120,17 +120,46 @@ class OverloadedError(KubeMLError):
         return d
 
 
+class EngineFaultError(KubeMLError):
+    """Retryable serving-engine failure: the decode engine faulted (or was
+    drained for shutdown) while this request was in flight. Carries
+    ``retryable: true`` plus the tokens emitted before the fault in
+    ``partial_tokens`` (one list per stream) so callers can resume a prompt
+    client-side or simply resubmit. Travels the envelope like
+    :class:`OverloadedError`'s ``retry_after`` so a proxy chain preserves the
+    partial output end to end."""
+
+    status_code = 503
+
+    def __init__(self, message: str = "",
+                 partial_tokens: Optional[list] = None,
+                 status_code: Optional[int] = None):
+        super().__init__(message or "decode engine fault, retry", status_code)
+        self.retryable = True
+        self.partial_tokens = [list(t) for t in (partial_tokens or [])]
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = super().to_dict()
+        d["retryable"] = True
+        d["partial_tokens"] = self.partial_tokens
+        return d
+
+
 def error_from_envelope(body: bytes | str, default_code: int = 500) -> KubeMLError:
     """Parse a ``{"error", "code"}`` envelope from a failed HTTP response into a
     typed error (reference: ml/pkg/error/error.go:36-59 CheckFunctionError).
     A 429 envelope rebuilds as :class:`OverloadedError` so its ``retry_after``
     survives proxy hops end to end."""
     retry_after = None
+    retryable = False
+    partial_tokens = None
     try:
         d = json.loads(body)
         msg = d.get("error", "unknown error")
         code = int(d.get("code", default_code))
         retry_after = d.get("retry_after")
+        retryable = bool(d.get("retryable"))
+        partial_tokens = d.get("partial_tokens")
     except (ValueError, TypeError, AttributeError):
         msg = body.decode(errors="replace") if isinstance(body, bytes) else str(body)
         code = default_code
@@ -139,4 +168,10 @@ def error_from_envelope(body: bytes | str, default_code: int = 500) -> KubeMLErr
             return OverloadedError(msg, retry_after=float(retry_after or 1.0))
         except (TypeError, ValueError):
             return OverloadedError(msg)
+    if retryable:
+        try:
+            return EngineFaultError(msg, partial_tokens=partial_tokens,
+                                    status_code=code)
+        except (TypeError, ValueError):
+            return EngineFaultError(msg, status_code=code)
     return KubeMLError(msg, code)

@@ -1529,6 +1529,342 @@ def run_chunked_prefill(config: Optional[Config] = None, quick: bool = True,
     return row
 
 
+# serving-recovery serve model (ISSUE 20): deliberately tiny — the chaos
+# storm replays every stream TWICE (baseline + faulted) and the drain hop
+# boots two more python processes, so compile time dominates wall clock
+_SNAP_SERVE_FN = """
+from kubeml_tpu.runtime.model import KubeModel
+from kubeml_tpu.data.dataset import KubeDataset
+from kubeml_tpu.models.gpt import CausalTransformer
+
+class D(KubeDataset):
+    def __init__(self):
+        super().__init__("unused")
+
+class Model(KubeModel):
+    def __init__(self):
+        super().__init__(D())
+    def build(self):
+        return CausalTransformer(vocab_size=101, max_len=64,
+                                 embed_dim=64, depth=2, num_heads=4)
+"""
+
+# drain half of the cross-process hop: boots a full cluster, gets streams
+# mid-decode, POSTs /serving/drain over the real wire (PSClient), proves
+# the 429 gate + the retryable-503-with-partials waiter contract, and
+# leaves KMS1 frames in KUBEML_SNAP_DIR for a process that does not exist
+# yet. Talks to the parent scenario via one JSON line on stdout.
+_DRAIN_PROC = """
+import json, sys, time
+import numpy as np
+from kubeml_tpu.api.config import get_config
+from kubeml_tpu.api.errors import EngineFaultError, KubeMLError
+from kubeml_tpu.api.types import GenerateRequest
+from kubeml_tpu.cluster import LocalCluster
+from kubeml_tpu.ps.transport import PSClient
+from kubeml_tpu.serving import kvsnap
+
+cfg = get_config()
+out = {"refs": {}, "partials": {}, "files": [], "gate_429": False}
+with LocalCluster(config=cfg) as cluster:
+    def gen(prompt, n):
+        return cluster.scheduler.generate(GenerateRequest(
+            model_id="snapserve", prompts=[prompt], max_new_tokens=n))
+
+    rng = np.random.default_rng(23)
+    prompts = [[int(t) for t in rng.integers(1, 101, size=l)]
+               for l in (9, 13)]
+    # uninterrupted references FIRST (same decoder, greedy => replayable)
+    for p in prompts:
+        out["refs"][str(len(p))] = gen(p, 40)["tokens"][0][:40]
+    dec = cluster.ps._decoders["snapserve"][0]
+    # throttle decode so the requests are still MID-STREAM when the drain
+    # lands: a warm engine this tiny would otherwise run 40 tokens out
+    # before the POST crosses the wire (a real model's chunk takes longer
+    # than an HTTP hop; this stands in for that)
+    _orig = dec._dispatch_chunk_paged
+    def _slow(*a, **kw):
+        time.sleep(0.3)
+        return _orig(*a, **kw)
+    dec._dispatch_chunk_paged = _slow
+    entries = [dec.submit(GenerateRequest(prompts=[p], max_new_tokens=40))
+               for p in prompts]
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if all(e.rows[0].out for e in entries):
+            break
+        time.sleep(0.01)
+    client = PSClient(cluster.ps_api.url)
+    # grace 0: snapshot the mid-stream rows NOW
+    drain = client.drain_serving(grace=0.0)
+    for path in drain.get("written", []):
+        with open(path, "rb") as f:
+            hdr = kvsnap.peek_header(f.read())
+        out["files"].append({"request_id": hdr["request_id"],
+                             "prompt_len": hdr["prompt_len"]})
+    for p, e in zip(prompts, entries):
+        try:
+            dec.wait(e, timeout=60)
+        except EngineFaultError as err:
+            out["partials"][str(len(p))] = err.partial_tokens[0]
+    try:
+        gen(prompts[0], 2)
+    except KubeMLError as err:
+        out["gate_429"] = (err.status_code == 429)
+print("DRAIN_RESULT " + json.dumps(out))
+"""
+
+# restore half: a FRESH process (new arena, new page pool, nothing shared
+# but the checkpoint store and KUBEML_SNAP_DIR) whose PS replays the
+# drained requests at boot; /serving/restored reports their completions.
+_RESTORE_PROC = """
+import json, time
+from kubeml_tpu.api.config import get_config
+from kubeml_tpu.cluster import LocalCluster
+from kubeml_tpu.ps.transport import PSClient
+
+cfg = get_config()
+with LocalCluster(config=cfg) as cluster:
+    client = PSClient(cluster.ps_api.url)
+    recs = []
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        recs = client.serving_restored()
+        if recs and all(r["done"] or r["error"] for r in recs):
+            break
+        time.sleep(0.25)
+print("RESTORE_RESULT " + json.dumps({"restored": recs}))
+"""
+
+
+def run_serving_recovery(config: Optional[Config] = None,
+                         quick: bool = True) -> dict:
+    """The mid-stream serving-recovery proof (ISSUE 20), two halves:
+
+    CHAOS — one live cluster serves >= 8 concurrent mixed-length greedy
+    streams through the paged engine with EVERYTHING on at once: a
+    prefix-shared prompt pair, int8 KV pages and self-speculative
+    decoding. An injected engine fault lands mid-decode; the engine
+    snapshots resident rows to KMS1, rebuilds the arena and replays them.
+    Every stream must finish bit-identical to its uninterrupted baseline,
+    the page pool must audit clean, and the snapshot/audit counters must
+    be visible on a REAL ps /metrics scrape.
+
+    DRAIN — one python process boots a cluster, gets requests mid-stream,
+    drains over the wire (POST /serving/drain) and exits; a SECOND fresh
+    process restores the KMS1 files from KUBEML_SNAP_DIR at boot and
+    finishes them bit-identical to the first process's references.
+
+    Returns the row ``scripts/serving_recovery_demo.sh`` appends to
+    ``results/serving_recovery.jsonl``."""
+    import dataclasses
+    import os
+    import shutil
+    import subprocess
+    import sys
+    import threading
+
+    import flax.linen as nn
+    import jax
+
+    from ..api.config import get_config
+    from ..api.types import GenerateRequest
+    from ..cluster import LocalCluster
+    from ..functions.registry import FunctionRegistry
+    from ..models.gpt import CausalTransformer
+    from ..storage.checkpoint import FINAL_TAG, CheckpointStore
+    from ..utils import traced_http
+
+    cfg = config or get_config()
+    cfg.ensure_dirs()
+    row: Dict = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                 "scenario": "serving-recovery", "quick": bool(quick)}
+
+    module = CausalTransformer(vocab_size=101, max_len=64, embed_dim=64,
+                               depth=2, num_heads=4)
+    variables = jax.tree.map(np.asarray, nn.meta.unbox(
+        module.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))))
+    if not FunctionRegistry(config=cfg).exists("snap-serve"):
+        FunctionRegistry(config=cfg).create("snap-serve", _SNAP_SERVE_FN)
+    CheckpointStore(config=cfg).save(
+        "snapserve", variables, epoch=1, tag=FINAL_TAG,
+        meta={"request": {"function_name": "snap-serve",
+                          "model_type": "snap-serve"}})
+
+    # --- half 1: the chaos storm (int8 KV + spec=self + prefix sharing) ---
+    streams = 8 if quick else 12
+    chaos_cfg = dataclasses.replace(
+        cfg, kv_quant="int8", serving_spec="self", spec_exit_layer=1,
+        spec_k=2, serving_slots=3, serving_chunk_steps=4,
+        serving_page_tokens=4, serving_prefix_cache=True,
+        pool_audit_interval=0.05)
+    rng = np.random.default_rng(11)
+    sysp = [int(t) for t in rng.integers(1, 101, size=12)]
+    prompts = [sysp + [int(t) for t in rng.integers(1, 101, size=3 + i)]
+               for i in range(2)]      # the prefix-shared pair
+    prompts += [[int(t) for t in rng.integers(1, 101, size=l)]
+                for l in (3, 9, 5, 12, 7, 16, 4, 10, 6, 14)[:streams - 2]]
+    max_news = ([14, 9, 6, 17, 8, 11, 12, 16] * 2)[:streams]
+    tokens: Dict[int, list] = {}
+    finished = {"n": 0}
+    retried = {"n": 0}
+    res_lock = threading.Lock()
+    with LocalCluster(config=chaos_cfg) as cluster:
+        from ..api.errors import EngineFaultError
+
+        def gen(prompt, n):
+            return cluster.scheduler.generate(GenerateRequest(
+                model_id="snapserve", prompts=[prompt], max_new_tokens=n))
+
+        refs = [gen(p, n)["tokens"][0][:n]
+                for p, n in zip(prompts, max_news)]
+        dec = cluster.ps._decoders["snapserve"][0]
+
+        def worker(i):
+            try:
+                r = gen(prompts[i], max_news[i])
+            except EngineFaultError as err:
+                # a row the fault caught fully-dispatched (pages already
+                # released) is unsalvageable BY DESIGN: its waiter gets the
+                # deterministic retryable 503 + partial tokens, and doing
+                # what the envelope says must land on the rebuilt engine
+                assert err.retryable and err.status_code == 503
+                assert err.partial_tokens is not None
+                with res_lock:
+                    retried["n"] += 1
+                r = gen(prompts[i], max_news[i])
+            with res_lock:
+                tokens[i] = r["tokens"][0][:max_news[i]]
+                finished["n"] += 1
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(streams)]
+        for t in threads:
+            t.start()
+        # arm the fault once the FIRST token of the storm lands: every
+        # stream is mid-flight (resident mid-decode or queued), none done
+        state = {"armed": True}
+
+        def poison(fn):
+            def boom(*a, **kw):
+                if state["armed"]:
+                    state["armed"] = False
+                    raise RuntimeError("scenario-injected device fault")
+                return fn(*a, **kw)
+            return boom
+
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            with dec._cond:
+                hot = any(r is not None and r.out for r in dec._slot_rows)
+            if hot:
+                break
+            time.sleep(0.005)
+        live_at_fault = streams - finished["n"]
+        dec._dispatch_chunk_paged = poison(dec._dispatch_chunk_paged)
+        dec._dispatch_spec_chunk = poison(dec._dispatch_spec_chunk)
+        for t in threads:
+            t.join(timeout=600)
+        assert not state["armed"], "the injected fault never fired"
+        assert len(tokens) == streams, (
+            f"only {len(tokens)}/{streams} streams completed after the "
+            f"fault")
+        mismatched = [i for i in range(streams) if tokens[i] != refs[i]]
+        assert not mismatched, (
+            f"recovery moved sampled tokens in streams {mismatched}")
+        chk = dec._pool.check()
+        assert chk["held"] == chk["trie_pages"], f"leaked pages: {chk}"
+        if dec._pool.trie is not None:
+            dec._pool.trie.flush()
+            assert dec._pool.check()["held"] == 0
+        metrics = traced_http.get(f"{cluster.ps_api.url}/metrics",
+                                  timeout=10).text
+
+    def counter(name):
+        return sum(
+            float(l.rsplit(" ", 1)[1]) for l in metrics.splitlines()
+            if l.startswith(name + "{") or l.startswith(name + " "))
+
+    chaos = {
+        "streams": streams, "prefix_shared": 2, "live_at_fault":
+        live_at_fault, "kv_quant": "int8", "spec": "self",
+        "parity_streams": streams, "retried_streams": retried["n"],
+        "snapshot_saved": counter("kubeml_serving_snapshot_saved_total"),
+        "snapshot_restored": counter(
+            "kubeml_serving_snapshot_restored_total"),
+        "snapshot_replayed": counter(
+            "kubeml_serving_snapshot_replayed_total"),
+        "snapshot_failed": counter("kubeml_serving_snapshot_failed_total"),
+        "pool_audit_runs": counter("kubeml_serving_pool_audit_runs_total"),
+        "pool_audit_failures": counter(
+            "kubeml_serving_pool_audit_failures_total"),
+    }
+    assert chaos["live_at_fault"] >= 8, (
+        f"only {chaos['live_at_fault']} streams were live at the fault")
+    assert chaos["snapshot_replayed"] >= 1, (
+        "no snapshot replayed through the fault (counters from the ps "
+        "/metrics scrape)")
+    # every snapshot failure must map to a stream the retryable-503
+    # contract re-ran (doomed draining rows fail without a counter)
+    assert chaos["snapshot_failed"] <= retried["n"]
+    assert chaos["pool_audit_runs"] >= 1, "the pool-audit watchdog never ran"
+    assert chaos["pool_audit_failures"] == 0
+    row["chaos"] = chaos
+
+    # --- half 2: graceful drain, restored by a process born later ---
+    snap_dir = str(Path(cfg.data_root) / "serving_snapshots_demo")
+    shutil.rmtree(snap_dir, ignore_errors=True)
+    env = dict(os.environ, KUBEML_DATA_ROOT=str(cfg.data_root),
+               KUBEML_SNAP_DIR=snap_dir)
+    # the drain hop serves plain f32 (raw KMS1 float pages, bit-exact):
+    # the chaos half already covered the int8 + spec composition
+    for k in ("KUBEML_KV_QUANT", "KUBEML_SERVING_SPEC"):
+        env.pop(k, None)
+    repo_root = str(Path(__file__).resolve().parents[2])
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    def hop(script, tag):
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              cwd=repo_root, capture_output=True, text=True,
+                              timeout=900)
+        for line in proc.stdout.splitlines():
+            if line.startswith(tag + " "):
+                return json.loads(line[len(tag) + 1:])
+        raise AssertionError(
+            f"{tag} process failed (rc={proc.returncode}):\n"
+            f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+
+    drained = hop(_DRAIN_PROC, "DRAIN_RESULT")
+    assert drained["gate_429"], "draining ps did not 429 new admissions"
+    assert len(drained["files"]) == 2, drained
+    for plen, partial in drained["partials"].items():
+        ref = drained["refs"][plen]
+        assert partial and partial == ref[:len(partial)], (
+            f"partial tokens not a prefix of the reference (plen={plen})")
+    restored = hop(_RESTORE_PROC, "RESTORE_RESULT")["restored"]
+    assert len(restored) == 2, restored
+    by_rid = {f["request_id"]: str(f["prompt_len"])
+              for f in drained["files"]}
+    for rec in restored:
+        assert rec["done"] and not rec["error"], rec
+        ref = drained["refs"][by_rid[rec["request_id"]]]
+        got = rec["tokens"][0][:rec["lengths"][0]]
+        assert got == ref, (
+            f"cross-process restore moved tokens for {rec['request_id']}")
+    leftovers = [f for f in os.listdir(snap_dir)
+                 if f.endswith(".kms")] if os.path.isdir(snap_dir) else []
+    assert not leftovers, f"restored snapshots not consumed: {leftovers}"
+    row["drain"] = {
+        "snapshots_written": len(drained["files"]),
+        "restored": len(restored),
+        "partials_prefix_of_reference": True,
+        "gate_429": True,
+        "cross_process_parity_requests": len(restored),
+    }
+    row["status"] = "ok"
+    return row
+
+
 # elastic-observability demo function: a tiny MLP whose DATASET carries a
 # controllable host-side brake — when the sentinel file named by
 # KUBEML_ELASTIC_OBS_BRAKE exists, every round's transform sleeps, slowing

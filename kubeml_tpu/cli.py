@@ -654,8 +654,14 @@ def cmd_top(args) -> int:
 
         rows = []
         for m in models:
+            # drain indicator (ISSUE 20): the decoder stopped admitting
+            # (POST /serving/drain or SIGTERM) and is snapshotting
+            # stragglers — flag the model so the operator sees why new
+            # requests 429
+            draining = metric(series, "kubeml_serving_draining", m,
+                              "latest")
             rows.append((
-                m,
+                m + (" [DRAIN]" if draining else ""),
                 fmt(metric(series, "kubeml_serving_goodput_tokens_total",
                            m, "rate"), 1),
                 fmt(metric(series, "kubeml_serving_queue_depth", m,

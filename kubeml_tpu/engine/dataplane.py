@@ -132,7 +132,9 @@ def _unflatten(pairs: Dict[str, np.ndarray]) -> dict:
 
 def _q8_scale(d: np.ndarray) -> np.ndarray:
     """Per-output-channel symmetric scale over the LAST axis for matrices
-    (ops/int8_matmul.py's channel convention), per-tensor for vectors."""
+    (ops/int8_matmul.py's channel convention), per-tensor for vectors.
+    Also the scale convention KMS1 request snapshots reuse for their
+    optional lossy float-page compression (serving/kvsnap.py)."""
     if d.ndim >= 2:
         absmax = np.max(np.abs(d), axis=tuple(range(d.ndim - 1)),
                         keepdims=True)

@@ -59,6 +59,11 @@ class CausalSelfAttention(nn.Module):
     # ``[B, max_len, ...]`` stripes; rows address it through per-row page
     # tables, so rows of different lengths share one step program without
     # padding every row to max_len. 0/0 (default) = dense cache only.
+    # This page-granular layout is also what makes a live request's decode
+    # state PORTABLE: serving/kvsnap.py gathers a row's written pages out
+    # of the arena into a KMS1 frame and scatters them back into any
+    # byte-compatible arena (same page_tokens/kv_quant), mid-stream
+    # (docs/design.md §24).
     page_tokens: int = 0
     kv_pages: int = 0
     # how the paged path READS the arena (KUBEML_PAGED_ATTN): "gather"

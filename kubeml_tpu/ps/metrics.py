@@ -74,6 +74,12 @@ OCCUPANCY_BUCKETS = (0.0625, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875,
 BANDWIDTH_BUCKETS = (1e6, 3e6, 1e7, 3e7, 1e8, 3e8, 1e9, 3e9, 1e10, 3e10,
                      1e11, 3e11, 1e12)
 
+# log-scaled byte edges for KMS1 snapshot frame sizes (serving/kvsnap.py):
+# a short test-model row is ~KB, a long-context production row with a deep
+# stack runs to hundreds of MB
+SNAPSHOT_BYTES_BUCKETS = (1e3, 1e4, 1e5, 3e5, 1e6, 3e6, 1e7, 3e7, 1e8,
+                          3e8, 1e9)
+
 
 class Histogram:
     """Minimal Prometheus histogram: fixed bucket edges, cumulative counts,
@@ -252,6 +258,28 @@ SERVING_COUNTERS = {
         "prefill_chunk_tokens",
         "Prompt tokens prefilled via the chunked path (subset of "
         "kubeml_serving_prefill_tokens_total)"),
+    # mid-stream recovery (ISSUE 20, serving/kvsnap.py): portable KMS1
+    # KV snapshots — saved on fault/drain, restored into a rebuilt or
+    # fresh engine, replayed through the admission queue
+    "kubeml_serving_snapshot_saved_total": (
+        "snapshot_saved", "Live-request KV snapshots captured (engine "
+                          "fault recovery or graceful drain)"),
+    "kubeml_serving_snapshot_restored_total": (
+        "snapshot_restored", "KV snapshots scattered into fresh pages and "
+                             "resumed mid-stream"),
+    "kubeml_serving_snapshot_replayed_total": (
+        "snapshot_replayed", "Rows re-admitted through the queue after an "
+                             "engine-fault snapshot-and-rebuild cycle"),
+    "kubeml_serving_snapshot_failed_total": (
+        "snapshot_failed", "Snapshot or restore attempts that failed "
+                           "(the request got a retryable error instead)"),
+    # KVPool invariant watchdog (KUBEML_POOL_AUDIT_INTERVAL)
+    "kubeml_serving_pool_audit_runs_total": (
+        "pool_audit_runs", "Periodic kvpool.check() invariant audits run "
+                           "under the engine lock"),
+    "kubeml_serving_pool_audit_failures_total": (
+        "pool_audit_failures", "Pool audits that found a broken invariant "
+                               "and triggered fault recovery"),
 }
 # XLA compile counter, labeled {model, program} — rendered from the
 # snapshot's per-program compile-count dict rather than the scalar tables
@@ -336,6 +364,13 @@ SERVING_HISTOGRAMS = {
     "kubeml_serving_compile_seconds": (
         "compile", "Per-compile wall time at the engine's jit-program "
                    "seams"),
+    # mid-stream recovery (ISSUE 20)
+    "kubeml_serving_snapshot_bytes": (
+        "snapshot_bytes", "KMS1 snapshot frame size per save/restore "
+                          "(page data + scale rows + token chunks)"),
+    "kubeml_serving_snapshot_seconds": (
+        "snapshot_seconds", "Wall time per snapshot capture or restore "
+                            "(arena gather/scatter + codec)"),
 }
 
 # histograms rendered as cause-labeled variants of ONE metric name: the
@@ -453,6 +488,11 @@ SERVING_GAUGES = {
     "kubeml_serving_compile_storm": (
         "compile_storm", "1 while the compile rate exceeds "
                          "KUBEML_COMPILE_STORM_PER_MIN (0 = healthy)"),
+    # graceful drain (ISSUE 20): 1 while the engine refuses admissions and
+    # runs down / snapshots live rows ahead of a shutdown
+    "kubeml_serving_draining": (
+        "draining", "1 while the decoder is draining for shutdown "
+                    "(admissions refused 429, live rows running down)"),
 }
 
 

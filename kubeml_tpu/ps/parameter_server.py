@@ -157,6 +157,10 @@ class ParameterServer:
         self._wire_cache: Dict[str, tuple] = {}
         self._wire_locks: Dict[str, threading.Lock] = {}
         self._decoders: Dict[str, tuple] = {}  # (BatchingDecoder, ckpt mtime)
+        # requests replayed from KUBEML_SNAP_DIR at boot (ISSUE 20): each
+        # row is {"model", "request_id", "file", "entry", "decoder"} — the
+        # /serving/restored route reads completion state off the entry
+        self._restored: List[dict] = []
         self._ckpt_store = CheckpointStore(config=self.cfg)
         from .journal import JobJournal
 
@@ -1357,7 +1361,8 @@ class ParameterServer:
                             paged_attn=self.cfg.paged_attn,
                             kv_quant=self.cfg.kv_quant,
                             spec_min_accept=self.cfg.spec_min_accept,
-                            prefill_chunk_tokens=self.cfg.prefill_chunk_tokens)
+                            prefill_chunk_tokens=self.cfg.prefill_chunk_tokens,
+                            pool_audit_interval=self.cfg.pool_audit_interval)
             spec_kw = self._spec_decoder_args(module)
             try:
                 decoder = PagedBatchingDecoder(module, variables,
@@ -1597,6 +1602,122 @@ class ParameterServer:
                 out[mid] = d.telemetry()
             except Exception:
                 log.debug("telemetry for %s failed", mid, exc_info=True)
+        return out
+
+    # --- graceful serving drain / boot replay (ISSUE 20) ---
+
+    def drain_serving(self, grace: Optional[float] = None) -> dict:
+        """``POST /serving/drain`` (and the SIGTERM seam): drain every
+        resident decoder — new admissions 429, live rows get up to
+        ``grace`` seconds (KUBEML_DRAIN_GRACE), stragglers snapshot into
+        portable KMS1 frames. With KUBEML_SNAP_DIR set the frames land
+        there (one ``<model>-<request>.kms`` each) for the next boot's
+        :meth:`restore_serving` to replay; without it the frames are
+        dropped (the waiters already got their retryable 503 + partial
+        tokens either way). Decoders without a drain seam (the dense
+        engine) just retire."""
+        import os
+
+        with self._lock:
+            decoders = {mid: d for mid, (d, _) in self._decoders.items()}
+        snap_dir = self.cfg.snap_dir
+        out = {"models": [], "snapshots": 0, "written": []}
+        for mid, d in decoders.items():
+            try:
+                if hasattr(d, "drain"):
+                    frames = d.drain(grace)
+                else:
+                    d.retire()
+                    frames = []
+            except Exception:
+                log.exception("draining decoder %s failed", mid)
+                continue
+            out["models"].append(mid)
+            out["snapshots"] += len(frames)
+            if not (snap_dir and frames):
+                continue
+            from ..serving import kvsnap
+
+            os.makedirs(snap_dir, exist_ok=True)
+            for frame in frames:
+                try:
+                    rid = (kvsnap.peek_header(frame).get("request_id")
+                           or f"r{len(out['written'])}")
+                    safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                                   for c in f"{mid}-{rid}")
+                    path = os.path.join(snap_dir,
+                                        safe + kvsnap.SNAP_SUFFIX)
+                    with open(path, "wb") as f:
+                        f.write(frame)
+                    out["written"].append(path)
+                except Exception:
+                    log.exception("writing snapshot for %s failed", mid)
+        return out
+
+    def restore_serving(self) -> dict:
+        """Boot-time replay: scan KUBEML_SNAP_DIR for ``.kms`` frames, route
+        each to its model's decoder by the KMS1 header, and re-admit it via
+        ``submit_snapshot`` — the generation continues mid-stream in this
+        process (greedy continuation bit-identical to the uninterrupted
+        run). A replayed file is deleted after admission; failures leave
+        the file in place and are reported, not raised (a corrupt frame
+        must not wedge boot)."""
+        import os
+
+        snap_dir = self.cfg.snap_dir
+        out = {"restored": [], "failed": []}
+        if not snap_dir or not os.path.isdir(snap_dir):
+            return out
+        from ..serving import kvsnap
+
+        for fname in sorted(os.listdir(snap_dir)):
+            if not fname.endswith(kvsnap.SNAP_SUFFIX):
+                continue
+            path = os.path.join(snap_dir, fname)
+            try:
+                with open(path, "rb") as f:
+                    frame = f.read()
+                mid = str(kvsnap.peek_header(frame).get("model") or "")
+                model, variables, mtime, mesh = self._load_serving(mid)
+                decoder = self._get_decoder(mid, model, variables, mtime,
+                                            mesh)
+                if decoder is None or not hasattr(decoder,
+                                                  "submit_snapshot"):
+                    raise KubeMLError(
+                        f"model {mid!r} has no snapshot-capable decoder",
+                        409)
+                entry = decoder.submit_snapshot(frame)
+                rec = {"model": mid, "request_id": entry.request_id,
+                       "file": fname, "entry": entry, "decoder": decoder}
+                with self._lock:
+                    self._restored.append(rec)
+                out["restored"].append({"model": mid,
+                                        "request_id": entry.request_id})
+                os.unlink(path)
+            except Exception as e:
+                log.warning("snapshot replay failed for %s: %s", fname, e)
+                out["failed"].append({"file": fname, "error": str(e)})
+        return out
+
+    def restored_snapshot(self) -> list:
+        """``GET /serving/restored``: replayed requests + their live state
+        (done flag, emitted token count, and the full tokens once done) —
+        the cross-process drain demo's ground truth."""
+        with self._lock:
+            recs = list(self._restored)
+        out = []
+        for rec in recs:
+            entry = rec["entry"]
+            done = entry.done_evt.is_set() and entry.error is None
+            row = {"model": rec["model"], "request_id": rec["request_id"],
+                   "file": rec["file"], "done": done,
+                   "error": str(entry.error) if entry.error else None,
+                   "lengths": [len(r.out) for r in entry.rows]}
+            if done:
+                res = entry.result()
+                row["tokens"] = [t[:n] for t, n in zip(res["tokens"],
+                                                       res["lengths"])]
+            out.append(row)
         return out
 
     def _serving_sharded_store(self):

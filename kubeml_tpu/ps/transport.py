@@ -44,6 +44,11 @@ class PSAPI:
         # controller's /tasks/{id}/trace reads the merged set back
         router.route("POST", "/traces/{taskId}", self._traces_post)
         router.route("GET", "/traces/{taskId}", self._traces_get)
+        # graceful serving drain (ISSUE 20): stop admitting, snapshot
+        # stragglers to KUBEML_SNAP_DIR; /serving/restored reports the
+        # requests replayed from that directory at boot
+        router.route("POST", "/serving/drain", self._serving_drain)
+        router.route("GET", "/serving/restored", self._serving_restored)
         self.service = Service(router, self.cfg.host, self.cfg.ps_port)
 
     def _start(self, req: Request):
@@ -128,10 +133,43 @@ class PSAPI:
     def _traces_get(self, req: Request):
         return self.ps.get_trace(req.params["taskId"])
 
+    def _serving_drain(self, req: Request):
+        body = req.json() or {}
+        return self.ps.drain_serving(grace=parse_grace_seconds(
+            body.get("grace")))
+
+    def _serving_restored(self, req: Request):
+        return self.ps.restored_snapshot()
+
     def start(self) -> "PSAPI":
         self.service.start()
         # the HTTP surface is up: /metrics/history needs samples flowing
         self.ps.start_telemetry()
+        # SIGTERM = the orchestrator's drain signal (pod eviction, rolling
+        # update): drain serving decoders — snapshot stragglers for the
+        # replacement process — then deliver the previous disposition.
+        # signal.signal only works on the main thread; embedded/test PSAPIs
+        # (started off-main) simply skip registration
+        import signal
+
+        def _on_term(signum, frame):
+            try:
+                self.ps.drain_serving()
+            except Exception:
+                pass
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                raise SystemExit(0)
+
+        try:
+            prev = signal.signal(signal.SIGTERM, _on_term)
+        except ValueError:
+            pass
+        # replay any snapshots a predecessor left in KUBEML_SNAP_DIR —
+        # their streams continue mid-generation in this process
+        if self.cfg.snap_dir:
+            self.ps.restore_serving()
         return self
 
     def stop(self) -> None:
@@ -240,6 +278,19 @@ class PSClient:
 
     def get_trace(self, task_id: str) -> dict:
         return _check(requests.get(f"{self.url}/traces/{task_id}",
+                                   timeout=self._timeout()))
+
+    def drain_serving(self, grace: Optional[float] = None) -> dict:
+        body: dict = {}
+        if grace is not None:
+            body["grace"] = grace
+        return _check(requests.post(f"{self.url}/serving/drain", json=body,
+                                    timeout=self._timeout(max(
+                                        120.0, self.timeout)),
+                                    idempotency_key=True))
+
+    def serving_restored(self) -> list:
+        return _check(requests.get(f"{self.url}/serving/restored",
                                    timeout=self._timeout()))
 
     def health(self) -> bool:

@@ -258,6 +258,13 @@ class _Row:
     # final admit); stays 0 for a monolithic prefill — short suffix or
     # KUBEML_PREFILL_CHUNK_TOKENS=0
     prefill_chunks: int = 0
+    # mid-stream restore (ISSUE 20): a kvsnap.RequestSnapshot whose pages
+    # must scatter into fresh arena pages before this row decodes; set on
+    # KMS1 admission and on fault-recovery replay, cleared at dispatch.
+    # ``out`` already holds the snapshot's emissions, so admission reserves
+    # via kvpool.reserve (private pages, no prefix-trie participation — the
+    # bytes come from another engine's write history) instead of admit
+    snapshot: Optional[object] = None
 
 
 @dataclass
@@ -651,6 +658,12 @@ class BatchingDecoder:
         self._cond = threading.Condition()
         self._closed = False
         self._retired = False
+        # graceful drain (ISSUE 20): while True, submit refuses with 429 +
+        # Retry-After (clients back off to another replica / the restart)
+        # but live rows keep decoding; the paged engine's drain() snapshots
+        # whatever is still running when the grace window closes
+        self._drain_mode = False
+        self._drain_deadline = 0.0
         self._warmed = False  # flips after the first processed chunk
         self._slab = None
         # steps already in the dispatch chain per slot (gates chunk dispatch)
@@ -915,6 +928,14 @@ class BatchingDecoder:
         with self._cond:
             if self._closed or self._retired:
                 raise DecoderClosed()
+            if self._drain_mode:
+                from ..api.errors import OverloadedError
+
+                self.stats.overloaded()
+                hint = max(1.0, self._drain_deadline - time.monotonic())
+                raise OverloadedError(
+                    "decoder is draining for shutdown; resubmit to another "
+                    "replica or after restart", retry_after=min(hint, 30.0))
             # admission limit gates on QUEUE pressure: a batch wider than the
             # limit still admits into an otherwise-empty queue (it was
             # serviceable before the limit existed and a retry could never
@@ -1176,6 +1197,8 @@ class BatchingDecoder:
         snap["slot_occupancy"] = busy / max(self.slots, 1)
         snap["weight_bytes"] = float(self.weight_bytes)
         snap["queue_limit"] = float(self.queue_limit)
+        # 1 while draining for shutdown (admissions 429; kubeml top DRAIN)
+        snap["draining"] = 1.0 if self._drain_mode else 0.0
         return snap
 
     @property
@@ -1300,7 +1323,7 @@ class BatchingDecoder:
                 # drain whatever the fetchers still owe so seqs stay aligned
                 pool.clear()
                 process_seq = next_seq
-                self._fail_all(e)
+                self._fail_all(e, wrap=True)
                 with self._cond:
                     if self._closed:
                         pool.stop()
@@ -1731,7 +1754,7 @@ class BatchingDecoder:
         if q is not None:
             q.put({"row": row.index, "tokens": tokens})
 
-    def _fail_all(self, error: Exception) -> None:
+    def _fail_all(self, error: Exception, wrap: bool = False) -> None:
         with self._cond:
             rows = (list(self._pending) + [r for r in self._slot_rows if r]
                     + list(self._draining))
@@ -1744,7 +1767,22 @@ class BatchingDecoder:
             row.done = True
             entry = row.entry
             if entry.error is None:
-                entry.error = error
+                # wrap=True (a LOOP fault — the engine rebuilds and keeps
+                # serving): an in-flight request gets a DETERMINISTIC
+                # retryable envelope — 503 + the tokens each stream emitted
+                # before the fault — never the raw backend exception (whose
+                # 500 a client must treat as fatal) and never a hang on
+                # done_evt (ISSUE 20 regression seam). Init failures and
+                # close() keep the raw error: the decoder is CLOSED, so
+                # "retry the same endpoint" would be a lie.
+                if not wrap or isinstance(error, KubeMLError):
+                    entry.error = error
+                else:
+                    from ..api.errors import EngineFaultError
+
+                    entry.error = EngineFaultError(
+                        f"decode engine fault: {error}",
+                        partial_tokens=[list(r.out) for r in entry.rows])
             if id(entry) not in failed_entries:
                 failed_entries.add(id(entry))
                 if self._record_outcome(entry):
@@ -1753,6 +1791,16 @@ class BatchingDecoder:
             entry.done_evt.set()
             if entry.stream_q is not None:
                 entry.stream_q.put(None)
+
+
+class _DrainReq:
+    """Rendezvous between ``drain()`` (a server thread) and the engine loop:
+    the engine quiesces its dispatch chain, snapshots stragglers into KMS1
+    frames, and posts them back through ``frames`` before setting ``evt``."""
+
+    def __init__(self):
+        self.evt = threading.Event()
+        self.frames: List[bytes] = []
 
 
 class PagedBatchingDecoder(BatchingDecoder):
@@ -1802,7 +1850,8 @@ class PagedBatchingDecoder(BatchingDecoder):
                  paged_attn: Optional[str] = None,
                  kv_quant: Optional[str] = None,
                  spec_min_accept: Optional[float] = None,
-                 prefill_chunk_tokens: Optional[int] = None, **kw):
+                 prefill_chunk_tokens: Optional[int] = None,
+                 pool_audit_interval: Optional[float] = None, **kw):
         if mesh is not None:
             raise ValueError(
                 "paged serving does not run on a mesh yet; use the dense "
@@ -2028,6 +2077,19 @@ class PagedBatchingDecoder(BatchingDecoder):
         # decode chunk when both contend for it
         self._prefill_pending: List[tuple] = []
         self._prefill_turn = True
+        # --- KVPool invariant watchdog (KUBEML_POOL_AUDIT_INTERVAL,
+        # ISSUE 20): the engine loop runs kvpool.check() every interval
+        # seconds under the engine lock; a tripped invariant fires the
+        # errorhook and routes through fault recovery (snapshot-and-replay)
+        # instead of decoding through silent accounting corruption. 0 = off
+        self.pool_audit_interval = float(
+            pool_audit_interval if pool_audit_interval is not None
+            else cfg.pool_audit_interval)
+        self._next_audit = 0.0
+        # graceful-drain rendezvous: drain() posts a _DrainReq; the engine
+        # thread quiesces the dispatch chain, snapshots stragglers, and
+        # hands the KMS1 frames back through it
+        self._drain_req: Optional[_DrainReq] = None
 
     # --- capacity & programs ---
 
@@ -2327,9 +2389,20 @@ class PagedBatchingDecoder(BatchingDecoder):
             if row.canceled:
                 self._pending.popleft()
                 continue
-            lease = self._pool.admit(row.prompt, row.max_new,
-                                     lookahead=self._spec_lookahead,
-                                     max_positions=self.max_len)
+            if row.snapshot is not None:
+                # restore admission: fresh PRIVATE pages for the snapshot
+                # scatter (no trie — the bytes come from another engine's
+                # write history, so sharing them would poison the prefix
+                # cache); budget-refused restores stay queued at the head
+                # exactly like plain rows until pages free
+                lease = self._pool.reserve(self._pool.total_positions(
+                    len(row.prompt), row.max_new,
+                    lookahead=self._spec_lookahead,
+                    max_positions=self.max_len))
+            else:
+                lease = self._pool.admit(row.prompt, row.max_new,
+                                         lookahead=self._spec_lookahead,
+                                         max_positions=self.max_len)
             if lease is None:
                 break
             self._pending.popleft()
@@ -2767,6 +2840,429 @@ class PagedBatchingDecoder(BatchingDecoder):
                             prefix_cache=self._pool.trie is not None)
         self._table[:] = 0
 
+    # --- mid-stream snapshot / restore / drain (ISSUE 20) ---
+
+    def submit_snapshot(self, frame, stream: bool = False) -> _Entry:
+        """Admit a KMS1 snapshot (bytes, or a decoded
+        :class:`kvsnap.RequestSnapshot`) as a first-class request: the row
+        re-enters the queue carrying its emitted tokens and — once the page
+        budget covers it — its pages scatter into fresh arena pages and it
+        continues decoding from its saved position (greedy continuation is
+        bit-identical to the uninterrupted run). A snapshot with zero
+        emissions simply re-prefills from its prompt. Geometry or storage
+        mismatches 409; a snapshot no arena this size could ever hold 400s;
+        a snapshot that is already complete resolves immediately."""
+        from . import kvsnap
+
+        snap = (frame if isinstance(frame, kvsnap.RequestSnapshot)
+                else kvsnap.decode_snapshot(frame))
+        if snap.model and snap.model != self.name:
+            raise KubeMLError(
+                f"snapshot was taken from model {snap.model!r}, this "
+                f"decoder serves {self.name!r}", 409)
+        if not snap.prompt:
+            raise KubeMLError("snapshot carries an empty prompt", 400)
+        plen = len(snap.prompt)
+        if plen + snap.max_new - 1 > self.max_len:
+            raise KubeMLError(
+                f"snapshot prompt ({plen}) + max_new ({snap.max_new}) - 1 "
+                f"exceeds the model's max_len ({self.max_len})", 400)
+        self._check_capacity(plen, snap.max_new)
+        done = bool(snap.out) and (
+            len(snap.out) >= snap.max_new
+            or (snap.eos >= 0 and snap.out[-1] == snap.eos))
+        if snap.out and not done:
+            # mid-stream state only restores into a byte-compatible arena
+            if int(snap.page_tokens) != self.page_tokens:
+                raise KubeMLError(
+                    f"snapshot page_tokens ({snap.page_tokens}) != engine "
+                    f"page_tokens ({self.page_tokens})", 409)
+            mine = "int8" if self.kv_quant == "int8" else "none"
+            theirs = "int8" if snap.kv_quant == "int8" else "none"
+            if mine != theirs:
+                raise KubeMLError(
+                    f"snapshot arena storage is {theirs!r}, engine stores "
+                    f"{mine!r} (KUBEML_KV_QUANT mismatch)", 409)
+            if self.spec == "draft":
+                raise KubeMLError(
+                    "mid-stream restore is unsupported under spec='draft' "
+                    "(the drafter's separate arena is not captured); "
+                    "resubmit the prompt", 409)
+            depth = getattr(self.module, "depth", None)
+            if depth is not None and len(snap.layers) != int(depth):
+                raise KubeMLError(
+                    f"snapshot has {len(snap.layers)} layers, model has "
+                    f"{depth}", 409)
+            heads = int(getattr(self.module, "num_heads", 0))
+            hd = (int(getattr(self.module, "embed_dim", 0)) // heads
+                  if heads else 0)
+            want = (self.page_tokens, heads, hd)
+            for layer in snap.layers:
+                got = tuple(int(x) for x in layer.k.shape[1:])
+                if heads and got != want:
+                    raise KubeMLError(
+                        f"snapshot layer {layer.name!r} page shape {got} "
+                        f"!= engine page shape {want}", 409)
+        from ..utils import resilience, tracing
+
+        rows: List[_Row] = []
+        entry = _Entry(rows=rows, max_new=int(snap.max_new),
+                       stream_q=queue.Queue() if stream else None,
+                       submitted_at=time.monotonic(),
+                       deadline=resilience.current_deadline(),
+                       request_id=snap.request_id or self._next_request_id(),
+                       wall0=time.time(),
+                       trace_ctx=tracing.current_context())
+        row = _Row(entry=entry, index=0,
+                   prompt=np.asarray(snap.prompt, np.int32),
+                   max_new=int(snap.max_new), temp=float(snap.temp),
+                   topk=int(snap.topk), eos=int(snap.eos),
+                   key=np.asarray(snap.key, np.uint32),
+                   out=list(snap.out),
+                   snapshot=snap if snap.out and not done else None)
+        rows.append(row)
+        with self._cond:
+            if self._closed or self._retired:
+                raise DecoderClosed()
+            if self._drain_mode and not done:
+                from ..api.errors import OverloadedError
+
+                self.stats.overloaded()
+                raise OverloadedError(
+                    "decoder is draining for shutdown; replay the snapshot "
+                    "elsewhere", retry_after=max(
+                        1.0, self._drain_deadline - time.monotonic()))
+            self.stats.submitted(1)
+            if done:
+                row.done = True
+            else:
+                # restores bypass the queue-limit shed gate: they ARE the
+                # replay of work this server already accepted once
+                self._pending.append(row)
+                if self._thread is None:
+                    self._thread = threading.Thread(
+                        target=self._loop, name=f"decode-{self.name}",
+                        daemon=True)
+                    self._thread.start()
+                self._cond.notify_all()
+        if stream and snap.out:
+            # the consumer sees the pre-snapshot emissions as one delta so
+            # the concatenated stream equals the uninterrupted stream
+            entry.stream_q.put({"row": 0, "tokens": list(snap.out)})
+        if done:
+            if self._record_outcome(entry):
+                self.stats.completed(0.0)
+                self._finish_timeline(entry, "completed")
+            entry.done_evt.set()
+            if entry.stream_q is not None:
+                entry.stream_q.put(None)
+        return entry
+
+    def _dispatch_restore(self, slot: int, row: _Row) -> None:
+        """Rebuild a snapshot row in its slot: scatter the saved pages into
+        the fresh lease's physical pages, then write the row's cursors —
+        ``tok=out[-1]``, ``pos=plen+m-1`` (the next write position),
+        ``remaining=max_new-m``, sampler key replayed m splits from the
+        root — exactly the state ``_prefill_admit_impl`` + m-1 steps would
+        have left. No program dispatch: the functional ``.at[].set`` updates
+        thread into the slab's value-dependency chain, so ordering against
+        in-flight dispatches is free."""
+        from . import kvsnap
+
+        snap = row.snapshot
+        t0 = time.monotonic()
+        m = len(row.out)
+        plen = len(row.prompt)
+        try:
+            npg = snap.npages
+            pages = list(row.lease.pages[:npg])
+            if len(pages) < npg:
+                raise kvsnap.SnapshotError(
+                    f"lease holds {len(pages)} pages, snapshot needs {npg}")
+            pos = plen + m - 1
+            keys = (kvsnap.replay_keys(snap.key, m) if row.temp > 0
+                    else np.zeros((2,), np.uint32))
+            s = self._slab
+            s.cache = kvsnap.scatter_pages(s.cache, pages, snap.layers)
+            s.tok = s.tok.at[slot].set(int(row.out[-1]))
+            s.pos = s.pos.at[slot].set(pos)
+            s.live = s.live.at[slot].set(True)
+            s.remaining = s.remaining.at[slot].set(row.max_new - m)
+            s.keys = s.keys.at[slot].set(jnp.asarray(keys))
+            s.temp = s.temp.at[slot].set(row.temp)
+            s.topk = s.topk.at[slot].set(row.topk)
+            s.eos = s.eos.at[slot].set(row.eos)
+        except Exception as e:
+            log.exception("%s: snapshot restore failed (slot %d)",
+                          self.name, slot)
+            self.stats.snapshot_fail()
+            self._pool.release(row.lease)
+            row.lease = None
+            row.snapshot = None
+            with self._cond:
+                self._free.append(slot)
+            from ..api.errors import EngineFaultError
+
+            self._fail_entry(row.entry, EngineFaultError(
+                f"snapshot restore failed: {e}",
+                partial_tokens=[list(row.out)]), self.stats.failed)
+            return
+        self._slot_rows[slot] = row
+        self._table[slot, :] = 0
+        self._table[slot, :len(row.lease.pages)] = row.lease.pages
+        # m-1 post-admit steps are "already dispatched" (their emissions
+        # ride in out); the chunk sizer sees exactly max_new - m to go
+        row.dispatched = m - 1
+        row.pos_cap = pos
+        row.prefilling = False
+        row.snapshot = None
+        now = time.monotonic()
+        if not row.slot_at:
+            row.slot_at = now
+            self.stats.phase("queue_wait", now - row.entry.submitted_at)
+        self.stats.snapshot_restore(kvsnap.snapshot_nbytes(snap), now - t0)
+
+    def _snapshot_row(self, row: _Row) -> Optional[object]:
+        """Capture one resident row's portable state (host side of KMS1):
+        tokens + knobs + the written arena pages gathered through its page
+        table. Returns None — after counting a snapshot failure — when the
+        state cannot be captured: draft-mode rows (the drafter's separate
+        arena isn't covered) and rows whose device state is poisoned by
+        the fault being recovered from."""
+        from . import kvsnap
+
+        if self.spec == "draft":
+            self.stats.snapshot_fail()
+            return None
+        t0 = time.monotonic()
+        try:
+            m = len(row.out)
+            npg = kvsnap.snapshot_pages_needed(len(row.prompt), m,
+                                               self.page_tokens)
+            if row.lease is None or len(row.lease.pages) < npg:
+                raise kvsnap.SnapshotError("row holds no page lease")
+            layers = (kvsnap.gather_pages(self._slab.cache,
+                                          list(row.lease.pages[:npg]))
+                      if npg else [])
+            snap = kvsnap.RequestSnapshot(
+                model=self.name, request_id=row.entry.request_id,
+                page_tokens=self.page_tokens,
+                kv_quant="int8" if self.kv_quant == "int8" else "none",
+                spec=self.spec or "off",
+                prompt=[int(t) for t in row.prompt], out=list(row.out),
+                max_new=row.max_new, temp=row.temp, topk=row.topk,
+                eos=row.eos, key=(int(row.key[0]), int(row.key[1])),
+                layers=layers)
+            self.stats.snapshot_save(kvsnap.snapshot_nbytes(snap),
+                                     time.monotonic() - t0)
+            return snap
+        except Exception:
+            log.exception("%s: row snapshot failed (request %s)",
+                          self.name, row.entry.request_id)
+            self.stats.snapshot_fail()
+            return None
+
+    def _recover_rows(self, error: Exception) -> List[_Row]:
+        """Fault recovery's salvage half: called from the engine loop's
+        except seam BEFORE the arena is reinitialized, while resident rows'
+        pages still hold their written history. Rows with consumed
+        emissions snapshot (the restore replays them bit-exactly for
+        greedy/plain-mode sampling); rows still prefilling reset to plain
+        re-prefill. Whatever cannot cross the rebuild — ``_draining`` rows
+        (pages already released at retire time), draft-mode rows, rows
+        whose gather hits poisoned device state — fails NOW with a
+        retryable 503 carrying partial tokens. Queued rows of healthy
+        entries stay queued. Returns salvageable rows in admission order,
+        snapshots attached."""
+        from ..api.errors import EngineFaultError
+
+        with self._cond:
+            resident = [r for r in self._slot_rows if r is not None]
+            draining = list(self._draining)
+            self._draining = []
+        doomed: Dict[int, _Entry] = {}
+        for row in draining:
+            if not row.done and not row.canceled:
+                doomed.setdefault(id(row.entry), row.entry)
+        salvaged: List[_Row] = []
+        for row in resident:
+            if row.done or row.canceled or id(row.entry) in doomed:
+                continue
+            snap = None
+            if row.out:
+                snap = self._snapshot_row(row)
+                if snap is None:
+                    doomed.setdefault(id(row.entry), row.entry)
+                    continue
+            row.snapshot = snap
+            row.lease = None  # the pool is rebuilt; old leases are void
+            row.dispatched = 0
+            row.pos_cap = 0
+            row.prefilling = False
+            row.drained = False
+            row.prefix_cached = 0
+            salvaged.append(row)
+        # one unsalvageable row dooms its whole entry (result() needs all
+        # rows) — drop doomed entries' siblings everywhere
+        salvaged = [r for r in salvaged if id(r.entry) not in doomed]
+        if doomed:
+            with self._cond:
+                self._pending = deque(r for r in self._pending
+                                      if id(r.entry) not in doomed)
+            for entry in doomed.values():
+                self._fail_entry(entry, EngineFaultError(
+                    f"decode engine fault: {error}; request state could "
+                    "not be snapshotted across the rebuild — retry",
+                    partial_tokens=[list(r.out) for r in entry.rows]),
+                    self.stats.failed)
+        return salvaged
+
+    def _audit_pool(self) -> None:
+        """KVPool invariant watchdog tick (KUBEML_POOL_AUDIT_INTERVAL): a
+        tripped ``check()`` fires the errorhook and re-raises into the
+        fault-recovery seam — corrupted page accounting must trigger a
+        rebuild, not decode garbage through aliased pages."""
+        try:
+            with self._cond:
+                self._pool.check()
+        except Exception as e:
+            self.stats.pool_audit(False)
+            log.error("%s: KVPool invariant audit FAILED: %s",
+                      self.name, e)
+            try:
+                from ..utils.errorhook import report_error
+
+                report_error("serving.pool_audit", f"{self.name}: {e}")
+            except Exception:
+                log.debug("pool-audit errorhook emission failed",
+                          exc_info=True)
+            raise
+        else:
+            self.stats.pool_audit(True)
+
+    def drain(self, grace: Optional[float] = None) -> List[bytes]:
+        """Graceful shutdown (checkpoint-and-yield for serving): stop
+        admitting (submit 429s with Retry-After), give live rows up to
+        ``grace`` seconds (KUBEML_DRAIN_GRACE) to run out, then snapshot
+        every straggler into a portable KMS1 frame — its waiter fails with
+        a retryable 503 carrying partial tokens — and return the frames.
+        The PS writes them under KUBEML_SNAP_DIR and replays them through
+        :meth:`submit_snapshot` on next boot. Returns [] when everything
+        finished inside the grace window."""
+        if grace is None:
+            from ..api.config import get_config
+
+            grace = float(get_config().drain_grace)
+        deadline = time.monotonic() + max(0.0, grace)
+        with self._cond:
+            self._drain_mode = True
+            self._drain_deadline = deadline
+            active = self._thread is not None and not self._closed
+            self._cond.notify_all()
+        if not active:
+            return []
+        while time.monotonic() < deadline:
+            with self._cond:
+                idle = (not self._pending and not self._busy()
+                        and not self._draining)
+            if idle:
+                return []
+            time.sleep(0.05)
+        req = _DrainReq()
+        with self._cond:
+            if self._closed:
+                return []
+            self._drain_req = req
+            self._cond.notify_all()
+        if not req.evt.wait(timeout=max(30.0, grace) + 120.0):
+            log.warning("%s: drain quiesce timed out", self.name)
+            return []
+        return list(req.frames)
+
+    def _drain_quiesce(self, pool, req: _DrainReq, process_seq: int,
+                       next_seq: int) -> int:
+        """Engine-thread half of :meth:`drain`: settle the dispatch chain
+        (host row state must equal device truth before gathering), encode
+        one KMS1 frame per straggler single-row request (zero emissions →
+        a stateless frame that re-prefills on replay), fail the drained
+        waiters retryably, release every lease — ``check()`` must come
+        back clean — and hand the frames to the drain() caller."""
+        from . import kvsnap
+        from ..api.errors import EngineFaultError
+
+        try:
+            while process_seq < next_seq:
+                process_seq = self._consume_ready(pool, process_seq,
+                                                  next_seq, True)
+        except Exception:
+            log.exception("%s: drain could not settle the dispatch chain",
+                          self.name)
+            pool.clear()
+            process_seq = next_seq
+        with self._cond:
+            resident = [r for r in self._slot_rows if r is not None]
+            queued = list(self._pending)
+            self._pending.clear()
+            draining = list(self._draining)
+            self._draining = []
+        entries: Dict[int, _Entry] = {}
+        for r in resident + queued + draining:
+            if not r.done and not r.canceled:
+                entries.setdefault(id(r.entry), r.entry)
+        frames: List[bytes] = []
+        for entry in entries.values():
+            snap = None
+            if len(entry.rows) == 1 and not entry.rows[0].drained:
+                r = entry.rows[0]
+                if r.out:
+                    snap = self._snapshot_row(r)
+                else:
+                    # queued / mid-prefill: no arena state worth shipping —
+                    # a stateless frame replays as a plain prefill
+                    t0 = time.monotonic()
+                    snap = kvsnap.RequestSnapshot(
+                        model=self.name, request_id=entry.request_id,
+                        page_tokens=self.page_tokens,
+                        kv_quant="int8" if self.kv_quant == "int8"
+                        else "none",
+                        spec=self.spec or "off",
+                        prompt=[int(t) for t in r.prompt], out=[],
+                        max_new=r.max_new, temp=r.temp, topk=r.topk,
+                        eos=r.eos, key=(int(r.key[0]), int(r.key[1])),
+                        layers=[])
+                    self.stats.snapshot_save(
+                        kvsnap.snapshot_nbytes(snap),
+                        time.monotonic() - t0)
+            if snap is not None:
+                try:
+                    frames.append(kvsnap.encode_snapshot(snap))
+                except Exception:
+                    log.exception("%s: drain frame encode failed (%s)",
+                                  self.name, entry.request_id)
+                    self.stats.snapshot_fail()
+            self._fail_entry(entry, EngineFaultError(
+                "decoder drained for shutdown"
+                + ("; request snapshotted for replay" if snap is not None
+                   else ""),
+                partial_tokens=[list(r.out) for r in entry.rows]),
+                self.stats.failed)
+        with self._cond:
+            for r in resident:
+                if r.lease is not None:
+                    self._pool.release(r.lease)
+                    r.lease = None
+            self._slot_rows = [None] * self.slots
+            self._free = list(range(self.slots))
+            self._table[:] = 0
+            self._prefill_pending = []
+            self._admits_inflight = 0
+            self._prefill_turn = True
+            self._drain_req = None
+            self._cond.notify_all()
+        req.frames = frames
+        req.evt.set()
+        return process_seq
+
     def telemetry(self) -> dict:
         snap = super().telemetry()
         snap.update(self._pool.telemetry())
@@ -2810,7 +3306,8 @@ class PagedBatchingDecoder(BatchingDecoder):
             self._sweep_expired()
             with self._cond:
                 while (not self._closed and not self._pending
-                       and not self._busy() and process_seq == next_seq):
+                       and not self._busy() and process_seq == next_seq
+                       and self._drain_req is None):
                     if self._retired:
                         self._slab = None  # free the arena's HBM
                         pool.stop()
@@ -2821,8 +3318,21 @@ class PagedBatchingDecoder(BatchingDecoder):
                     return
                 room = self.pipeline_depth - (next_seq - process_seq)
                 admits = (self._take_admissions_locked(room)
-                          if room > 0 else [])
+                          if room > 0 and self._drain_req is None else [])
             try:
+                req = self._drain_req
+                if req is not None and not admits:
+                    # graceful drain: quiesce, snapshot stragglers, hand
+                    # the KMS1 frames back to the drain() caller
+                    process_seq = self._drain_quiesce(pool, req,
+                                                      process_seq, next_seq)
+                    next_seq = process_seq
+                    continue
+                if (self.pool_audit_interval > 0
+                        and time.monotonic() >= self._next_audit):
+                    self._next_audit = (time.monotonic()
+                                        + self.pool_audit_interval)
+                    self._audit_pool()
                 dispatched = False
                 live_admits = []
                 for slot, row in admits:
@@ -2830,6 +3340,12 @@ class PagedBatchingDecoder(BatchingDecoder):
                         self._pool.release(row.lease)
                         with self._cond:
                             self._free.append(slot)
+                        continue
+                    if row.snapshot is not None:
+                        # KMS1 restore: scatter saved pages + cursors into
+                        # the slab directly — no prefill program runs
+                        self._dispatch_restore(slot, row)
+                        dispatched = True
                         continue
                     if (self.prefill_chunk and len(row.prompt)
                             - row.lease.prefill_pos > self.prefill_chunk):
@@ -2890,7 +3406,11 @@ class PagedBatchingDecoder(BatchingDecoder):
                 log.exception("%s: paged decode loop failed", self.name)
                 pool.clear()
                 process_seq = next_seq
-                self._fail_all(e)
+                # snapshot-what-you-can BEFORE the arena reinitializes —
+                # resident rows' pages still hold their written history;
+                # unsalvageable entries fail retryably inside (ISSUE 20).
+                # Queued rows of healthy entries stay queued.
+                salvaged = self._recover_rows(e)
                 with self._cond:
                     if self._closed:
                         pool.stop()
@@ -2904,7 +3424,28 @@ class PagedBatchingDecoder(BatchingDecoder):
                     self._reset_engine_state()
                     self._slab = self._init_slab()
                 except Exception:
+                    # rebuild failed: the engine is permanently down — the
+                    # salvaged rows live nowhere _fail_all can see, so
+                    # fail their entries here first
                     with self._cond:
                         self._closed = True
+                    from ..api.errors import EngineFaultError
+
+                    for entry in {id(r.entry): r.entry
+                                  for r in salvaged}.values():
+                        self._fail_entry(entry, EngineFaultError(
+                            f"decode engine fault: {e}; rebuild failed",
+                            partial_tokens=[list(r.out)
+                                            for r in entry.rows]),
+                            self.stats.failed)
+                    self._fail_all(e, wrap=True)
                     pool.stop()
                     return
+                if salvaged:
+                    # replay: snapshot rows re-enter at the head of the
+                    # queue (they were admitted before anything queued now)
+                    with self._cond:
+                        for row in reversed(salvaged):
+                            self._pending.appendleft(row)
+                        self._cond.notify_all()
+                    self.stats.snapshot_replay(len(salvaged))

@@ -315,6 +315,19 @@ class KVPool:
         return PageLease(pages=shared + fresh, shared=len(shared),
                          prefix_tokens=pre, prefill_pos=pre)
 
+    def reserve(self, total_tokens: int) -> Optional[PageLease]:
+        """Reserve fresh PRIVATE pages for ``total_tokens`` positions with
+        no prefix-trie participation — the snapshot-restore admission path
+        (serving/kvsnap.py). A restored row's page bytes came from another
+        engine's write history (int8 scales and all), so sharing them
+        through this pool's trie, or matching this pool's cached blocks in
+        place of them, would mix arenas. None when the pool can't cover it
+        (the snapshot stays queued, same as a refused admit)."""
+        fresh = self._alloc(self.pages_for(total_tokens))
+        if fresh is None:
+            return None
+        return PageLease(pages=fresh)
+
     def register_prefix(self, prompt: Sequence[int], lease: PageLease) -> None:
         """Cache a just-dispatched prefill's full prompt blocks for future
         sharers (no-op with the prefix cache off)."""

@@ -44,7 +44,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-from ..ps.metrics import BANDWIDTH_BUCKETS, Histogram, OCCUPANCY_BUCKETS
+from ..ps.metrics import (BANDWIDTH_BUCKETS, Histogram, OCCUPANCY_BUCKETS,
+                          SNAPSHOT_BYTES_BUCKETS)
 from ..utils.timeseries import Series
 
 # ring sizes: enough for stable p95 under load, bounded for a resident server
@@ -131,6 +132,18 @@ class DecoderStats:
         # KUBEML_PREFILL_CHUNK_TOKENS path actually ran
         self.prefill_chunks = 0
         self.prefill_chunk_tokens = 0
+        # mid-stream recovery (ISSUE 20, serving/kvsnap.py): KMS1 snapshot
+        # lifecycle — saved (fault/drain capture), restored (scattered into
+        # fresh pages and resumed), replayed (re-admitted through the queue
+        # after a fault rebuild), failed (either direction; the request got
+        # a retryable error instead of a silent hang)
+        self.snapshot_saved = 0
+        self.snapshot_restored = 0
+        self.snapshot_replayed = 0
+        self.snapshot_failed = 0
+        # KVPool invariant watchdog (KUBEML_POOL_AUDIT_INTERVAL)
+        self.pool_audit_runs = 0
+        self.pool_audit_failures = 0
         # compile tracker (ISSUE 18): distinct traced XLA programs keyed by
         # (program label, shape signature); per-label compile counts; the
         # storm threshold is set by the engine from config (compiles/min
@@ -186,6 +199,10 @@ class DecoderStats:
         self._hist_kv_bw = Histogram(BANDWIDTH_BUCKETS)
         # per-verify-step acceptance-ratio distribution (0..1 edges)
         self._hist_spec_accept = Histogram(OCCUPANCY_BUCKETS)
+        # KMS1 snapshot frame sizes (log byte edges) and capture/restore
+        # walls — one observation per save AND per restore
+        self._hist_snap_bytes = Histogram(SNAPSHOT_BYTES_BUCKETS)
+        self._hist_snap_seconds = Histogram()
         # live gauges are read from the decoder at render time (queue depth,
         # busy slots) — they belong to the engine's own state, not counters
 
@@ -328,6 +345,42 @@ class DecoderStats:
         with self._lock:
             self.prefill_chunks += int(rows)
             self.prefill_chunk_tokens += int(tokens)
+
+    def snapshot_save(self, nbytes: int, seconds: float) -> None:
+        """One live row's KV state captured into a KMS1 frame (engine
+        fault recovery or graceful drain)."""
+        with self._lock:
+            self.snapshot_saved += 1
+            self._hist_snap_bytes.observe(float(nbytes))
+            self._hist_snap_seconds.observe(max(0.0, float(seconds)))
+
+    def snapshot_restore(self, nbytes: int, seconds: float) -> None:
+        """One snapshot scattered into fresh pages and resumed mid-stream."""
+        with self._lock:
+            self.snapshot_restored += 1
+            self._hist_snap_bytes.observe(float(nbytes))
+            self._hist_snap_seconds.observe(max(0.0, float(seconds)))
+
+    def snapshot_replay(self, rows: int) -> None:
+        """``rows`` snapshotted rows re-admitted through the queue after a
+        fault snapshot-and-rebuild cycle."""
+        if rows <= 0:
+            return
+        with self._lock:
+            self.snapshot_replayed += int(rows)
+
+    def snapshot_fail(self, rows: int = 1) -> None:
+        """A snapshot capture or restore attempt failed — the request was
+        failed with a clean retryable error instead."""
+        with self._lock:
+            self.snapshot_failed += int(rows)
+
+    def pool_audit(self, ok: bool) -> None:
+        """One periodic kvpool.check() invariant audit completed."""
+        with self._lock:
+            self.pool_audit_runs += 1
+            if not ok:
+                self.pool_audit_failures += 1
 
     def cold_start(self, seconds: float) -> None:
         """A first-call (trace+compile) wall observed outside the decode
@@ -529,6 +582,18 @@ class DecoderStats:
             # speculative-decoding series only exist once a spec step ran:
             # dense decoders / spec-off engines keep a clean exposition
             # (absence reads as "not speculating", like the paged gauges)
+            # recovery series exist only once a snapshot/audit event ran:
+            # a decoder that never faulted, drained, or audited keeps a
+            # clean exposition (same absence convention as the spec series)
+            if (self.snapshot_saved or self.snapshot_restored
+                    or self.snapshot_replayed or self.snapshot_failed):
+                out["snapshot_saved"] = float(self.snapshot_saved)
+                out["snapshot_restored"] = float(self.snapshot_restored)
+                out["snapshot_replayed"] = float(self.snapshot_replayed)
+                out["snapshot_failed"] = float(self.snapshot_failed)
+            if self.pool_audit_runs:
+                out["pool_audit_runs"] = float(self.pool_audit_runs)
+                out["pool_audit_failures"] = float(self.pool_audit_failures)
             if self.spec_steps:
                 out["spec_steps"] = float(self.spec_steps)
                 out["spec_drafted_tokens"] = float(self.spec_drafted_tokens)
@@ -552,7 +617,9 @@ class DecoderStats:
                            ("slot_idle", self._hist_slot_idle),
                            ("occupancy_ratio", self._hist_occupancy),
                            ("kv_bandwidth", self._hist_kv_bw),
-                           ("spec_accept_ratio", self._hist_spec_accept)):
+                           ("spec_accept_ratio", self._hist_spec_accept),
+                           ("snapshot_bytes", self._hist_snap_bytes),
+                           ("snapshot_seconds", self._hist_snap_seconds)):
                 if h.count:
                     hist[key] = h.snapshot()
         if hist:

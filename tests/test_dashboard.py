@@ -70,6 +70,15 @@ def _exported_names() -> set:
     stats.prefill_chunk(2, 48)
     if stats.compile_begin("step", (8,)):
         stats.compiled("step", 0.4)
+    # mid-stream recovery (ISSUE 20): snapshot/restore/replay counters,
+    # the KMS1 size/latency histograms, and the pool-audit watchdog —
+    # all conditionally exposed, so the seed must fire each event
+    stats.snapshot_save(1 << 16, 0.01)
+    stats.snapshot_restore(1 << 16, 0.02)
+    stats.snapshot_replay(2)
+    stats.snapshot_fail()
+    stats.pool_audit(True)
+    stats.pool_audit(False)
     stats.chunk_occupancy(8, 20, 6, 6)
     stats.admit_tokens(10, 22)
     stats.kv_read(1 << 20, 0.01)
@@ -84,7 +93,8 @@ def _exported_names() -> set:
                  "slot_occupancy": 0.25, "weight_bytes": 1024.0,
                  "queue_limit": 16.0, "spec_k": 4.0,
                  "paged_attn_kernel": 1.0, "kv_quant": 1.0,
-                 "spec_disabled": 0.0, "prefills_in_progress": 1.0})
+                 "spec_disabled": 0.0, "prefills_in_progress": 1.0,
+                 "draining": 0.0})
     reg.set_serving_source(lambda: {"drift-model": snap})
     # SLO burn/state gauges
     reg.set_slo_source(lambda: {"burn": {("drift", "fast"): 0.5},
@@ -225,6 +235,23 @@ def test_chunked_prefill_panels_present():
                    "kubeml_serving_prefills_in_progress"):
         assert metric in refs, f"no panel charts {metric}"
     assert "kubeml_serving_hol_stall_seconds_total" in refs
+
+
+def test_serving_recovery_panels_present():
+    """The ISSUE-20 panels: snapshot save/restore/replay/fail rates with
+    the draining gauge, the KMS1 frame-size and capture-latency
+    histograms, and the kvpool invariant-audit watchdog."""
+    refs = _dashboard_names()
+    for metric in ("kubeml_serving_snapshot_saved_total",
+                   "kubeml_serving_snapshot_restored_total",
+                   "kubeml_serving_snapshot_replayed_total",
+                   "kubeml_serving_snapshot_failed_total",
+                   "kubeml_serving_snapshot_bytes_bucket",
+                   "kubeml_serving_snapshot_seconds_bucket",
+                   "kubeml_serving_draining",
+                   "kubeml_serving_pool_audit_runs_total",
+                   "kubeml_serving_pool_audit_failures_total"):
+        assert metric in refs, f"no panel charts {metric}"
 
 
 # Exported metrics deliberately NOT charted — the reverse drift guard

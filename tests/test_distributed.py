@@ -198,8 +198,17 @@ def test_dashboard_metrics_exist_in_registry():
     stats.cold_start(0.5)
     if stats.compile_begin("step", (8,)):
         stats.compiled("step", 0.4)
+    # serving-recovery signals (ISSUE 20 panels: snapshot counters + size/
+    # latency histograms, pool-audit watchdog counters, draining gauge)
+    stats.snapshot_save(1 << 16, 0.01)
+    stats.snapshot_restore(1 << 16, 0.02)
+    stats.snapshot_replay(2)
+    stats.snapshot_fail()
+    stats.pool_audit(True)
+    stats.pool_audit(False)
     snap = stats.snapshot()
     snap["paged_attn_kernel"] = 0.0
+    snap["draining"] = 0.0
     reg.set_serving_source(lambda: {"m": snap})
     # SLO burn/state gauges (the burn-rate and alert-state panels)
     reg.set_slo_source(lambda: {"burn": {("o", "fast"): 0.5},
